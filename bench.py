@@ -1,0 +1,87 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Metric: GPT-2 training MFU on the available TPU chip(s), via the engine's
+fused train_batch path (bf16, ZeRO-0 single chip). vs_baseline compares our
+model-flops utilization against the reference's published 52%-of-peak
+BERT-large number (BASELINE.md: 66 TFLOPS on a 125 TFLOP V100,
+docs/_posts/2020-05-19-bert-record.md:14).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def flops_per_token(cfg):
+    """Approximate training FLOPs per token: 6*N + attention term."""
+    n_params = cfg.num_params()
+    # 6ND for the dense matmuls + 12*L*H*T for attention scores/values.
+    return 6 * n_params
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    platform = jax.default_backend()
+    # Size the model to the hardware: full GPT-2 355M on a real TPU chip,
+    # tiny on CPU (so the harness still runs end-to-end anywhere).
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        cfg = GPT2Config.gpt2_medium(dropout=0.0)
+        batch, seq, steps = 8, 1024, 20
+        peak_flops = 197e12  # v5e bf16 peak per chip
+    else:
+        cfg = GPT2Config.tiny(dropout=0.0)
+        batch, seq, steps = 8, 64, 5
+        peak_flops = 1e12
+
+    model = GPT2LMHeadModel(cfg)
+    engine, _, _, _ = deepspeed.initialize(
+        model=model,
+        config_params={
+            "train_batch_size": batch * jax.device_count(),
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2} if jax.device_count() > 1 else {},
+        })
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(batch * jax.device_count(), seq))
+
+    # Warmup/compile
+    loss = engine.train_batch(batch=(ids, ids))
+    jax.block_until_ready(loss)
+
+    t0 = time.time()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=(ids, ids))
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+
+    tokens = batch * jax.device_count() * seq * steps
+    tokens_per_sec_per_chip = tokens / dt / jax.device_count()
+    mfu = tokens_per_sec_per_chip * flops_per_token(cfg) / peak_flops
+
+    print(json.dumps({
+        "metric": "gpt2_{}_tokens_per_sec_per_chip".format(
+            "355m" if on_tpu else "tiny"),
+        "value": round(tokens_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.52, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "platform": platform,
+            "devices": jax.device_count(),
+            "loss": float(loss),
+            "params": cfg.num_params(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

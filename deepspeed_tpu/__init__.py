@@ -1,0 +1,110 @@
+"""deepspeed_tpu — a TPU-native large-model training framework with the
+capability surface of DeepSpeed v0.3.10, rebuilt on JAX/XLA/pjit/Pallas.
+
+API façade mirrors reference deepspeed/__init__.py: ``initialize()`` returns
+``(engine, optimizer, training_dataloader, lr_scheduler)``;
+``add_config_arguments()`` injects the --deepspeed argparse group;
+``init_distributed()`` boots the multi-host runtime (jax.distributed instead
+of NCCL/torch.distributed).
+"""
+
+from deepspeed_tpu import ops  # noqa: F401
+from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments
+from deepspeed_tpu.utils.distributed import init_distributed  # noqa: F401
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.version import git_branch, git_hash, version as __version__
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config_params=None,
+               mesh=None):
+    """Initialize the DeepSpeed engine (reference deepspeed/__init__.py:50-139).
+
+    Arguments keep the reference contract; ``model`` is a flax module (or any
+    ``init``/``apply`` object), ``model_parameters`` the param pytree (or None
+    for lazy init at first forward). A ``PipelineModule`` model selects the
+    pipeline engine. Extra TPU-only kwarg: ``mesh`` to supply a prebuilt
+    jax.sharding.Mesh.
+
+    Returns: tuple of ``engine, optimizer, training_dataloader, lr_scheduler``.
+    """
+    log_dist("DeepSpeed info: version={}, git-hash={}, git-branch={}".format(
+        __version__, git_hash, git_branch), ranks=[0])
+
+    assert model is not None, "deepspeed.initialize requires a model"
+
+    from deepspeed_tpu.pipe import PipelineModule
+    if isinstance(model, PipelineModule):
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+        engine = PipelineEngine(args=args,
+                                model=model,
+                                optimizer=optimizer,
+                                model_parameters=model_parameters,
+                                training_data=training_data,
+                                lr_scheduler=lr_scheduler,
+                                mpu=model.mpu() if hasattr(model, "mpu") else mpu,
+                                dist_init_required=dist_init_required,
+                                collate_fn=collate_fn,
+                                config_params=config_params,
+                                mesh=mesh)
+    else:
+        engine = DeepSpeedEngine(args=args,
+                                 model=model,
+                                 optimizer=optimizer,
+                                 model_parameters=model_parameters,
+                                 training_data=training_data,
+                                 lr_scheduler=lr_scheduler,
+                                 mpu=mpu,
+                                 dist_init_required=dist_init_required,
+                                 collate_fn=collate_fn,
+                                 config_params=config_params,
+                                 mesh=mesh)
+
+    return_items = [
+        engine,
+        engine.optimizer,
+        engine.training_dataloader,
+        engine.lr_scheduler,
+    ]
+    return tuple(return_items)
+
+
+def _add_core_arguments(parser):
+    """Core DeepSpeed argparse group (reference __init__.py:142-190)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed",
+                       default=False,
+                       action="store_true",
+                       help="Enable DeepSpeed (helper flag for user code, no "
+                       "impact on DeepSpeed backend)")
+    group.add_argument("--deepspeed_config",
+                       default=None,
+                       type=str,
+                       help="DeepSpeed json configuration file.")
+    group.add_argument("--deepscale",
+                       default=False,
+                       action="store_true",
+                       help="Deprecated enable DeepSpeed (helper flag for user "
+                       "code, no impact on DeepSpeed backend)")
+    group.add_argument("--deepscale_config",
+                       default=None,
+                       type=str,
+                       help="Deprecated DeepSpeed json configuration file.")
+    return parser
+
+
+def add_config_arguments(parser):
+    """Update an argument parser to enable ds_config parsing
+    (reference __init__.py:193-206)."""
+    parser = _add_core_arguments(parser)
+    return parser

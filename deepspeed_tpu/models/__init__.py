@@ -1,0 +1,2 @@
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel, create_model
+from deepspeed_tpu.models.simple import LinearStack, SimpleModel

@@ -1,0 +1,171 @@
+"""GPT-2 family in flax — the flagship model for the TPU framework.
+
+The reference ships no models in-tree (users bring Megatron/HF models and the
+fused ``DeepSpeedTransformerLayer``); our TPU framework provides a first-class
+GPT-2 implementation sized per the perf-baseline configs
+(/root/reference/tests/model/Megatron_GPT2/run_perf_baseline.py:18-60:
+1.5B/4B/8B configs) so benchmarks and parity tests are self-contained.
+
+TPU-first design notes:
+- compute dtype bf16 by default, fp32 params (master weights live with the
+  optimizer; see engine precision handling);
+- weights laid out so QKV/MLP matmuls hit the MXU as single large GEMMs;
+- causal mask folded into the softmax via additive bias (no dynamic shapes);
+- optional ``jax.checkpoint`` (remat) per block — the activation-checkpointing
+  equivalent (reference activation_checkpointing/checkpointing.py:314).
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.1
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    # parallelism hints consumed by deepspeed_tpu.parallel when sharding
+    use_flash_attention: bool = True
+
+    @classmethod
+    def gpt2_small(cls, **kw):
+        return cls(n_embd=768, n_layer=12, n_head=12, **kw)
+
+    @classmethod
+    def gpt2_medium(cls, **kw):
+        return cls(n_embd=1024, n_layer=24, n_head=16, **kw)
+
+    @classmethod
+    def gpt2_large(cls, **kw):
+        return cls(n_embd=1280, n_layer=36, n_head=20, **kw)
+
+    @classmethod
+    def gpt2_xl(cls, **kw):
+        # 1.5B — the BASELINE.md north-star config.
+        return cls(n_embd=1600, n_layer=48, n_head=25, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 1024)
+        kw.setdefault("n_positions", 128)
+        kw.setdefault("dropout", 0.0)
+        return cls(n_embd=64, n_layer=2, n_head=4, **kw)
+
+    def num_params(self):
+        wpe = self.n_positions * self.n_embd
+        wte = self.vocab_size * self.n_embd
+        per_block = 12 * self.n_embd * self.n_embd + 13 * self.n_embd
+        return wte + wpe + self.n_layer * per_block + 2 * self.n_embd
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        B, T, C = x.shape
+        nh, hd = cfg.n_head, C // cfg.n_head
+
+        # One fused QKV GEMM (MXU-friendly: [B*T, C] x [C, 3C]).
+        qkv = nn.Dense(3 * C, dtype=cfg.dtype, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+
+        att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(hd).astype(cfg.dtype)
+        causal_mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        att = jnp.where(causal_mask[None, None, :, :], att, jnp.finfo(cfg.dtype).min)
+        att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
+
+        y = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+        y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
+        y = nn.Dense(C, dtype=cfg.dtype, name="c_proj")(y)
+        y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return y
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, name="c_fc")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj")(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return h
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        # Pre-LN transformer block (GPT-2 style).
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
+        x = x + CausalSelfAttention(cfg, name="attn")(h, deterministic)
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        x = x + MLP(cfg, name="mlp")(h, deterministic)
+        return x
+
+
+class GPT2LMHeadModel(nn.Module):
+    """GPT-2 causal LM. Returns loss when labels given (DeepSpeed convention:
+    the model's forward output is the loss; see reference tests
+    simple_model.py:9-25 where models return CE loss directly)."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, deterministic=True):
+        cfg = self.config
+        B, T = input_ids.shape
+        assert T <= cfg.n_positions
+
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.n_embd), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.n_positions, cfg.n_embd), jnp.float32)
+
+        x = wte.astype(cfg.dtype)[input_ids] + wpe.astype(cfg.dtype)[None, :T]
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, static_argnums=(2,))
+        for i in range(cfg.n_layer):
+            x = block_cls(cfg, name="h_{}".format(i))(x, deterministic)
+
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        # Tied LM head: logits in fp32 for a stable softmax-xent.
+        logits = jnp.einsum("btc,vc->btv", x.astype(jnp.float32),
+                            wte.astype(jnp.float32))
+
+        if labels is None:
+            return logits
+
+        # Next-token prediction: shift inside the loss.
+        logits_s = logits[:, :-1]
+        labels_s = labels[:, 1:]
+        logp = jax.nn.log_softmax(logits_s, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels_s[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+
+def create_model(config=None, **kw):
+    config = config or GPT2Config(**kw)
+    return GPT2LMHeadModel(config)

@@ -1,0 +1,47 @@
+"""Small test models mirroring the reference test fixtures
+(/root/reference/tests/unit/simple_model.py:9-78): models whose forward output
+IS the loss, so `loss = engine(x, y); engine.backward(loss); engine.step()`
+works exactly like DeepSpeed's test loop.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SimpleModel(nn.Module):
+    """1-2 Linear layers + cross-entropy loss (reference simple_model.py:9-25)."""
+
+    hidden_dim: int
+    empty_grad: bool = False
+
+    @nn.compact
+    def __call__(self, x, y, deterministic=True):
+        h = nn.Dense(self.hidden_dim, name="linear")(x)
+        if self.empty_grad:
+            # Extra layer that contributes nothing to the loss — its grads
+            # stay zero (the reference uses this for unbalanced-grad tests).
+            nn.Dense(self.hidden_dim, name="linear2")
+        logp = nn.log_softmax(h)
+        nll = -jnp.take_along_axis(logp, y[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+
+class LinearStack(nn.Module):
+    """Plain stack of equal Linear layers + CE loss, the serial twin of the
+    pipeline-parallel LinearStackPipe (reference simple_model.py:28-78)."""
+
+    input_dim: int = 128
+    hidden_dim: int = 128
+    output_dim: int = 128
+    num_layers: int = 4
+
+    @nn.compact
+    def __call__(self, x, y, deterministic=True):
+        x = nn.Dense(self.hidden_dim, use_bias=False, name="input_layer")(x)
+        for i in range(self.num_layers):
+            x = nn.Dense(self.hidden_dim, use_bias=False,
+                         name="serial_{}".format(i))(x)
+        x = nn.Dense(self.output_dim, use_bias=False, name="output_layer")(x)
+        logp = nn.log_softmax(x)
+        nll = -jnp.take_along_axis(logp, y[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)

@@ -1,0 +1,2 @@
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb

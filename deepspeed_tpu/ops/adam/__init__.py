@@ -1,0 +1,1 @@
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam

@@ -1,0 +1,8 @@
+from deepspeed_tpu.parallel import mesh
+from deepspeed_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    build_mesh,
+    zero_shardings,
+)

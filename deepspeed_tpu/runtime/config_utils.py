@@ -1,0 +1,21 @@
+"""Config helpers: scalar getters + duplicate-key-rejecting JSON object hook.
+
+Mirrors reference runtime/config_utils.py (27 LoC): ``dict_raise_error_on_duplicate_keys``
+is the object_pairs_hook passed to json.load so malformed configs fail loudly.
+"""
+
+
+def get_scalar_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys while building a dict from JSON pairs."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError("Duplicate keys in DeepSpeed config: {}".format(keys))
+    return d

@@ -1,0 +1,62 @@
+"""Compressed sparse-row tensor for sparse (embedding) gradients.
+
+Behavior-parity port of reference runtime/csr_tensor.py:11-59. On TPU the
+index/value pair is carried as jnp arrays; the sparse all-reduce is an
+all-gather of (indices, values) over the data axis (engine.csr_allreduce),
+mirroring the reference's dim-padded allgather strategy.
+"""
+
+import jax.numpy as jnp
+
+
+class CSRTensor(object):
+    """Compressed Sparse Row format: row indices + dense value rows."""
+
+    def __init__(self, dense_tensor=None, indices=None, values=None, dense_size=None):
+        self.orig_dense_tensor = dense_tensor
+        if dense_tensor is not None:
+            # Rows with any non-zero entry are kept (embedding-grad style
+            # sparsity: most rows untouched by a batch are all-zero).
+            row_mask = jnp.any(dense_tensor != 0, axis=tuple(range(1, dense_tensor.ndim)))
+            idx = jnp.nonzero(row_mask)[0]
+            self.indices = idx
+            self.values = dense_tensor[idx]
+            self.dense_size = tuple(dense_tensor.shape)
+        else:
+            self.indices = indices
+            self.values = values
+            self.dense_size = tuple(dense_size) if dense_size is not None else None
+
+    @staticmethod
+    def type():
+        return "deepspeed_tpu.CSRTensor"
+
+    def to_dense(self):
+        dense = jnp.zeros(self.dense_size, dtype=self.values.dtype)
+        return dense.at[self.indices].add(self.values)
+
+    def sparse_size(self):
+        index_size = self.indices.shape[0]
+        row_size = 1
+        for d in self.dense_size[1:]:
+            row_size *= d
+        sparse_size = index_size + index_size * row_size
+        dense_size = 1
+        for d in self.dense_size:
+            dense_size *= d
+        return sparse_size, dense_size
+
+    def add(self, b):
+        assert self.dense_size == b.dense_size
+        self.indices = jnp.concatenate([self.indices, b.indices])
+        self.values = jnp.concatenate([self.values, b.values])
+
+    def __str__(self):
+        sparse_size, dense_size = self.sparse_size()
+        return ("DeepSpeed.CSRTensor(indices_size={}, values_size={}, "
+                "dense_size={}, device=TPU, reduction_factor={:.2f})".format(
+                    self.indices.shape, self.values.shape, self.dense_size,
+                    dense_size / max(sparse_size, 1)))
+
+    def __repr__(self):
+        return self.__str__()

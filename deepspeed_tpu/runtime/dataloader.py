@@ -1,0 +1,124 @@
+"""Data loading: distributed-sharded loader + infinite repeating wrapper.
+
+TPU-native counterpart of reference runtime/dataloader.py (101 LoC). Instead of
+a torch DataLoader + DistributedSampler, ``DeepSpeedDataLoader`` shards any
+indexable dataset across the data-parallel axis, batches to the engine's
+micro-batch, and yields numpy/JAX-ready arrays. torch datasets/tensors are
+accepted and converted (torch is CPU-only in this environment).
+"""
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart on StopIteration (reference dataloader.py:10-29)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch
+
+
+def _to_numpy(x):
+    if isinstance(x, np.ndarray):
+        return x
+    # torch tensors (CPU) and jax arrays both support __array__/numpy().
+    if hasattr(x, "detach"):
+        return x.detach().cpu().numpy()
+    if hasattr(x, "numpy"):
+        return np.asarray(x)
+    return np.asarray(x)
+
+
+def _stack_batch(samples):
+    """Stack a list of samples (each a tuple/list/dict/array) into batch arrays."""
+    first = samples[0]
+    if isinstance(first, (tuple, list)):
+        return type(first)(
+            _stack_batch([s[i] for s in samples]) for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: _stack_batch([s[k] for s in samples]) for k in first}
+    return np.stack([_to_numpy(s) for s in samples])
+
+
+class DeepSpeedDataLoader(object):
+    """Shards + batches a dataset over the data-parallel group.
+
+    Matches the construction contract of reference dataloader.py:32-101:
+    built by the engine's ``deepspeed_io`` with the micro-batch size and dp
+    rank/world size; ``len()`` is the per-rank number of batches.
+    """
+
+    def __init__(self,
+                 dataset,
+                 batch_size,
+                 local_rank=0,
+                 data_parallel_world_size=1,
+                 data_parallel_rank=0,
+                 collate_fn=None,
+                 num_local_io_workers=None,
+                 data_sampler=None,
+                 drop_last=True,
+                 shuffle=False,
+                 seed=0):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn
+        self.dp_world_size = data_parallel_world_size
+        self.dp_rank = data_parallel_rank
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+
+        n = len(dataset)
+        per_rank = n // self.dp_world_size if drop_last else \
+            (n + self.dp_world_size - 1) // self.dp_world_size
+        self.num_samples = per_rank
+        self.len = per_rank // batch_size if drop_last else \
+            (per_rank + batch_size - 1) // batch_size
+        if self.len == 0:
+            logger.warning(
+                "DeepSpeedDataLoader: dataset of size {} yields 0 batches at "
+                "micro-batch {} over {} ranks".format(n, batch_size,
+                                                      self.dp_world_size))
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def _indices(self):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            rng.shuffle(order)
+        # Round-robin shard like DistributedSampler: rank r takes order[r::W].
+        mine = order[self.dp_rank::self.dp_world_size]
+        return mine[:self.num_samples]
+
+    def __iter__(self):
+        indices = self._indices()
+        for start in range(0, len(indices), self.batch_size):
+            batch_idx = indices[start:start + self.batch_size]
+            if self.drop_last and len(batch_idx) < self.batch_size:
+                return
+            samples = [self.dataset[int(i)] for i in batch_idx]
+            if self.collate_fn is not None:
+                yield self.collate_fn(samples)
+            else:
+                yield _stack_batch(samples)
+
+    def __len__(self):
+        return self.len

@@ -1,0 +1,109 @@
+"""Static & dynamic loss scaling.
+
+Behavior-parity port of reference fp16/loss_scaler.py:34-221. The scaler state
+(cur_scale, cur_iter, hysteresis) lives on host as Python scalars; the engine
+passes ``loss_scale`` into the jitted train step as a device scalar each step,
+so scale changes never trigger recompilation. Overflow detection is a jnp
+isfinite-reduction over gradients (see runtime/utils.py CheckOverflow).
+
+On TPU the default precision is bf16, which needs no scaling — these classes
+exist for exact ds_config ``fp16`` semantics (skipped-step counters, scale
+windows) so reference configs and tests behave identically.
+"""
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScalerBase:
+    """Base class: holds cur_scale and implements scaling helpers."""
+
+    def __init__(self, cur_scale):
+        self.cur_scale = cur_scale
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, module, grad_in, grad_out):
+        # Kept for API parity; JAX grads are scaled explicitly in the engine.
+        import jax
+        return jax.tree_util.tree_map(lambda g: g * self.loss_scale, grad_in)
+
+    def update_scale(self, overflow):
+        pass
+
+    def backward(self, loss, retain_graph=False):
+        # In the JAX engine, "backward" = grad of (loss * scale); this helper
+        # returns the scaled loss for use inside the loss function.
+        return loss * self.loss_scale
+
+
+class LossScaler(LossScalerBase):
+    """Static loss scaler (reference loss_scaler.py:60-88)."""
+
+    def __init__(self, scale=1):
+        super(LossScaler, self).__init__(scale)
+
+    def has_overflow(self, params):
+        return False
+
+    def _has_inf_or_nan(self, x):
+        return False
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Dynamic loss scaler: ×2 after ``scale_window`` clean iters, ÷2 on
+    overflow with hysteresis, floored at ``min_scale``
+    (reference loss_scaler.py:91-210).
+    """
+
+    def __init__(self,
+                 init_scale=2 ** 32,
+                 scale_factor=2.0,
+                 scale_window=1000,
+                 min_scale=1,
+                 delayed_shift=1,
+                 consecutive_hysteresis=False):
+        super(DynamicLossScaler, self).__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.cur_hysteresis = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def update_scale(self, overflow):
+        if overflow:
+            if self.delayed_shift == 1 or self.cur_hysteresis == 1:
+                self.cur_scale = max(self.cur_scale / self.scale_factor,
+                                     self.min_scale)
+            else:
+                self.cur_hysteresis -= 1
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if self.consecutive_hysteresis:
+                self.cur_hysteresis = self.delayed_shift
+            if (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+                if not self.consecutive_hysteresis:
+                    self.cur_hysteresis = self.delayed_shift
+                self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+
+def CreateLossScaler(dynamic_scaling, static_loss_scale, dynamic_loss_args):
+    """Build a scaler from ds_config-derived values (reference arg plumbing)."""
+    if dynamic_scaling:
+        if dynamic_loss_args is None:
+            return DynamicLossScaler()
+        return DynamicLossScaler(
+            init_scale=dynamic_loss_args.get("INITIAL_LOSS_SCALE", 2 ** 32),
+            scale_window=dynamic_loss_args.get("SCALE_WINDOW", 1000),
+            delayed_shift=dynamic_loss_args.get("DELAYED_SHIFT", 1),
+            min_scale=dynamic_loss_args.get("MIN_LOSS_SCALE", 1),
+        )
+    return LossScaler(scale=static_loss_scale)

@@ -1,0 +1,33 @@
+"""Progressive Layer Drop schedule (reference runtime/progressive_layer_drop.py:5-33).
+
+theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar. The engine injects
+``progressive_layer_drop=True, pld_theta=get_theta()`` kwargs into each forward
+(engine.py:815-816) and advances the state at every model step (:1003-1004).
+"""
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ProgressiveLayerDrop(object):
+    def __init__(self, theta=0.5, gamma=0.001):
+        super().__init__()
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist("Enabled progressive layer dropping (theta = {})".format(theta),
+                 ranks=[0])
+
+    def get_state(self):
+        kwargs = {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+        return kwargs
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        def _prob(x, gamma, p):
+            return (1.0 - p) * np.exp(-gamma * x) + p
+
+        self.current_theta = _prob(global_step, self.gamma, self.theta)

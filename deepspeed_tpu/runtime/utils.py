@@ -1,0 +1,324 @@
+"""Runtime helpers: overflow checks, norms, partitioners, PartitionedTensor,
+memory reporting.
+
+TPU-native counterpart of reference runtime/utils.py (558 LoC):
+- ``CheckOverflow``/``has_overflow``: jnp isfinite reduction over grad pytrees,
+  with an optional psum over a named model-parallel axis — replaces the serial
+  NaN/inf scan + MP-group allreduce (reference utils.py:41-131).
+- ``get_grad_norm``/``get_weight_norm``: global 2-norms over pytrees with
+  model-parallel reduction hooks (reference utils.py:148-269).
+- ``partition_uniform``/``partition_balanced``: pure-Python prefix-sum
+  partitioners used by the pipeline layer splitter (reference utils.py:289-370)
+- ``PartitionedTensor``: 1-D shard + meta encode + all-gather rebuild used by
+  pipeline×TP activation sharding (reference utils.py:373-476); collective
+  rebuild uses ``jax.lax.all_gather`` over a named axis inside shard_map.
+- ``see_memory_usage``/``memory_status`` via device memory_stats.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def noop_decorator(func):
+    return func
+
+
+def _tree_leaves(grads):
+    if isinstance(grads, (list, tuple)):
+        leaves = []
+        for g in grads:
+            leaves.extend(jax.tree_util.tree_leaves(g))
+        return leaves
+    return jax.tree_util.tree_leaves(grads)
+
+
+def has_overflow(grads, mp_axis=None):
+    """True if any grad is non-finite. Traceable; returns a device scalar.
+
+    With ``mp_axis`` set (inside shard_map/pmap over a model-parallel axis),
+    the flag is max-reduced over the axis like the reference's MP-group
+    allreduce (utils.py:91-109).
+    """
+    leaves = _tree_leaves(grads)
+    if not leaves:
+        return jnp.array(False)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+             for g in leaves]
+    overflow = jnp.any(jnp.stack(flags))
+    if mp_axis is not None:
+        overflow = jax.lax.pmax(overflow.astype(jnp.int32), mp_axis) > 0
+    return overflow
+
+
+class CheckOverflow(object):
+    """Stateful wrapper matching the reference class shape (utils.py:41-131)."""
+
+    def __init__(self, param_groups=None, mpu=None, zero_reduce_scatter=False):
+        self.mpu = mpu
+        self.params = param_groups
+        self.zero_reduce_scatter = zero_reduce_scatter
+
+    def check_using_norm(self, norm_group):
+        overflow = any(float(norm) in (float("inf"), float("-inf")) or
+                       norm != norm for norm in norm_group)
+        return overflow
+
+    def check(self, grads, mp_axis=None):
+        return has_overflow(grads, mp_axis=mp_axis)
+
+    def has_overflow_serial(self, grads):
+        return bool(jax.device_get(has_overflow(grads)))
+
+    def has_overflow(self, grads):
+        return bool(jax.device_get(has_overflow(grads)))
+
+
+def global_norm(tree):
+    """L2 norm over all leaves of a pytree. Traceable."""
+    leaves = _tree_leaves(tree)
+    if not leaves:
+        return jnp.array(0.0, jnp.float32)
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    return jnp.sqrt(sq)
+
+
+def get_grad_norm(gradients, norm_type=2, mp_axis=None):
+    """Gradient norm; inf-norm and 2-norm supported (reference utils.py:148-203).
+
+    With ``mp_axis``, partial norms are reduced over the model-parallel axis.
+    """
+    leaves = _tree_leaves(gradients)
+    norm_type = float(norm_type)
+    if norm_type == float("inf"):
+        if not leaves:
+            return jnp.array(0.0, jnp.float32)
+        total_norm = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g.astype(jnp.float32))) for g in leaves]))
+        if mp_axis is not None:
+            total_norm = jax.lax.pmax(total_norm, mp_axis)
+        return total_norm
+    if not leaves:
+        return jnp.array(0.0, jnp.float32)
+    total_norm_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    if mp_axis is not None:
+        total_norm_sq = jax.lax.psum(total_norm_sq, mp_axis)
+    return total_norm_sq ** (1.0 / norm_type)
+
+
+def get_weight_norm(parameters, norm_type=2, mp_axis=None):
+    return get_grad_norm(parameters, norm_type=norm_type, mp_axis=mp_axis)
+
+
+def clip_grad_norm_(gradients, max_norm, norm_type=2, mp_axis=None):
+    """Return gradients scaled so their global norm is at most max_norm.
+
+    Functional version of torch's clip_grad_norm_ as used by the reference
+    fp16 optimizers: clip_coef = max_norm / (norm + 1e-6).
+    """
+    total_norm = get_grad_norm(gradients, norm_type=norm_type, mp_axis=mp_axis)
+    clip_coef = max_norm / (total_norm + 1e-6)
+    clip_coef = jnp.minimum(clip_coef, 1.0)
+    clipped = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * clip_coef).astype(g.dtype), gradients)
+    return clipped, total_norm
+
+
+def is_model_parallel_parameter(p):
+    return hasattr(p, "model_parallel") and p.model_parallel
+
+
+def prefix_sum_inc(weights):
+    """Compute an inclusive prefix sum (reference utils.py:289-295)."""
+    weights_ = [w for w in weights]
+    for x in range(1, len(weights_)):
+        weights_[x] += weights_[x - 1]
+    return weights_
+
+
+def partition_uniform(num_items, num_parts):
+    """Evenly spaced part boundaries (reference utils.py:298-302)."""
+    parts = [0] * (num_parts + 1)
+    chunksize = num_items // num_parts
+    for p in range(num_parts):
+        parts[p] = min(chunksize * p, num_items)
+    parts[num_parts] = num_items
+    return parts
+
+
+def _lprobe(weights, num_parts, bottleneck):
+    num_items = len(weights)
+    total_weight = weights[-1]
+
+    # initialize partitioning
+    parts = [0] * (num_parts + 1)
+    for p in range(1, num_parts + 1):
+        parts[p] = num_items
+
+    bsum = bottleneck  # running max-sum of current partition
+    chunksize = num_items // num_parts
+    step = chunksize
+    for p in range(1, num_parts):
+        # Jump to the next bucket
+        while step < num_items and weights[step] < bsum:
+            step += chunksize
+        # Find the end index of current partition
+        parts[p] = bisect_left(weights, bsum,
+                               lo=step - chunksize,
+                               hi=min(step, num_items))
+        # Nothing more to partition
+        if parts[p] == num_items:
+            # See if the current partition is overweight
+            part_size = weights[-1] - weights[parts[p - 1]]
+            return parts, part_size < bottleneck
+        # Next partition target
+        bsum = weights[parts[p] - 1] + bottleneck
+
+    return parts, bsum >= total_weight
+
+
+def bisect_left(a, x, lo=0, hi=None):
+    import bisect as _bisect
+    if hi is None:
+        hi = len(a)
+    return _bisect.bisect_left(a, x, lo, hi)
+
+
+def _rb_partition_balanced(weights, num_parts, eps):
+    total_weight = weights[-1]
+    lower = total_weight / num_parts  # best case heaviest partition
+    upper = total_weight  # worst case heaviest partition
+
+    # Do a binary search for the best partitioning
+    while upper > lower + eps:
+        mid = lower + ((upper - lower) / 2)
+        parts, success = _lprobe(weights, num_parts, mid)
+        if success:
+            upper = mid
+        else:
+            lower = mid + eps
+    return upper
+
+
+def partition_balanced(weights, num_parts, eps=1e-3):
+    """Balance prefix-sum partition via binary search (reference utils.py:304-370)."""
+    num_items = len(weights)
+    # First check for the trivial edge case
+    if num_items <= num_parts:
+        return partition_uniform(num_items, num_parts)
+
+    weights_ = prefix_sum_inc(weights)
+
+    # Find the smallest bottleneck (weight of heaviest partition)
+    bottleneck = _rb_partition_balanced(weights_, num_parts, eps=eps)
+
+    # Now compute that partitioning
+    parts, success = _lprobe(weights_, num_parts, bottleneck)
+    assert success
+
+    return parts
+
+
+class PartitionedTensor:
+    """1-D sharded view of a tensor for cross-stage transport.
+
+    Matches the reference contract (runtime/utils.py:373-476): ``to_meta()``
+    encodes {orig shape, partition offsets} as an int array that can ride the
+    pipeline p2p channel; ``full()`` rebuilds via all-gather over the group.
+
+    TPU-native: the "group" is a named mesh axis; inside shard_map,
+    ``full(axis_name)`` uses jax.lax.all_gather. On host (no axis), shards are
+    kept in a list and concatenated.
+    """
+
+    def __init__(self, tensor, group_size, rank, axis_name=None):
+        self.group_size = group_size
+        self.rank = rank
+        self.axis_name = axis_name
+        self.orig_size = tuple(tensor.shape)
+        self.orig_dtype = tensor.dtype
+        flat = tensor.reshape(-1)
+        self._numel = flat.shape[0]
+        # Pad so the flat tensor divides evenly (partitions aligned like
+        # reference partition_uniform over numel).
+        chunk = -(-self._numel // group_size)
+        pad = chunk * group_size - self._numel
+        flat = jnp.pad(flat, (0, pad))
+        self.partition_size = chunk
+        self.local_data = jax.lax.dynamic_slice(flat, (rank * chunk,), (chunk,))
+
+    @classmethod
+    def from_meta(cls, meta, local_part, group_size, rank, axis_name=None,
+                  dtype=jnp.float32):
+        self = cls.__new__(cls)
+        meta = np.asarray(jax.device_get(meta)).tolist() if not isinstance(meta, (list, tuple)) else list(meta)
+        ndims = int(meta[0])
+        self.orig_size = tuple(int(x) for x in meta[1:1 + ndims])
+        self._numel = int(np.prod(self.orig_size))
+        self.group_size = group_size
+        self.rank = rank
+        self.axis_name = axis_name
+        self.orig_dtype = dtype
+        self.partition_size = local_part.shape[0]
+        self.local_data = local_part
+        return self
+
+    def to_meta(self):
+        """Encode [ndims, *shape] as an int32 vector (host-side)."""
+        return np.array([len(self.orig_size)] + list(self.orig_size),
+                        dtype=np.int32)
+
+    def data(self):
+        return self.local_data
+
+    def local_size(self):
+        return self.partition_size
+
+    def full(self, axis_name=None):
+        """Rebuild the full tensor. Inside shard_map pass the mesh axis name."""
+        axis = axis_name or self.axis_name
+        if axis is not None:
+            gathered = jax.lax.all_gather(self.local_data, axis, tiled=True)
+        else:
+            gathered = self.local_data
+        flat = gathered.reshape(-1)[:self._numel]
+        return flat.reshape(self.orig_size).astype(self.orig_dtype)
+
+
+def memory_status(msg="", print_rank=-1, reset_max=False):
+    """Print device memory stats (reference utils.py:483-512 analogue)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        stats = {}
+    new_alloced = stats.get("bytes_in_use", 0)
+    max_alloced = stats.get("peak_bytes_in_use", 0)
+    limit = stats.get("bytes_limit", 0)
+    GB = 1024 ** 3
+    logger.info(
+        "MEMSTATS {} device={} current alloc={:.4f}GB  peak alloc={:.4f}GB  "
+        "limit={:.4f}GB".format(msg, jax.local_devices()[0].platform,
+                                new_alloced / GB, max_alloced / GB, limit / GB))
+
+
+def see_memory_usage(message, force=False):
+    if not force:
+        return
+    memory_status(msg=message)
+
+
+def ensure_directory_exists(filename):
+    import os
+    dirname = os.path.dirname(filename)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+
+
+def set_random_seed(seed):
+    """Seed python/numpy RNGs and return a jax PRNGKey (RNG is pure in JAX)."""
+    import random
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)

@@ -1,0 +1,92 @@
+"""Multi-host distributed bootstrap.
+
+TPU-native replacement for reference utils/distributed.py:11-131: instead of
+``torch.distributed.init_process_group('nccl')`` with MPI/AzureML env
+discovery, we initialize the JAX multi-controller runtime
+(``jax.distributed.initialize``) from the same environment-variable contract
+(MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE set by the launcher, or MPI env
+discovery via OMPI_* variables).
+"""
+
+import os
+
+from deepspeed_tpu.utils.logging import logger
+
+_initialized = False
+
+
+def is_initialized():
+    return _initialized
+
+
+def init_distributed(dist_backend="ici",
+                     auto_mpi_discovery=True,
+                     distributed_port=29500,
+                     verbose=True,
+                     coordinator_address=None,
+                     num_processes=None,
+                     process_id=None):
+    """Initialize the multi-host JAX runtime if env vars indicate >1 process.
+
+    Single-process (the common single-host TPU-VM case): nothing to do — JAX
+    sees all local chips already. Multi-host: rendezvous at
+    MASTER_ADDR:MASTER_PORT with RANK/WORLD_SIZE, mirroring the reference's
+    env contract (utils/distributed.py:62-87).
+    """
+    global _initialized
+    if _initialized:
+        return
+
+    if auto_mpi_discovery and "OMPI_COMM_WORLD_SIZE" in os.environ and \
+            "RANK" not in os.environ:
+        mpi_discovery(distributed_port=distributed_port, verbose=verbose)
+
+    world_size = int(num_processes if num_processes is not None
+                     else os.environ.get("WORLD_SIZE", 1))
+    if world_size <= 1:
+        _initialized = True
+        return
+
+    rank = int(process_id if process_id is not None
+               else os.environ.get("RANK", 0))
+    addr = coordinator_address or "{}:{}".format(
+        os.environ.get("MASTER_ADDR", "127.0.0.1"),
+        os.environ.get("MASTER_PORT", distributed_port))
+
+    if verbose:
+        logger.info(
+            "Initializing JAX distributed backend at {} rank={} world_size={}"
+            .format(addr, rank, world_size))
+    import jax
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=world_size,
+                               process_id=rank)
+    _initialized = True
+
+
+def mpi_discovery(distributed_port=29500, verbose=True):
+    """Derive RANK/WORLD_SIZE/MASTER_ADDR from Open MPI env vars
+    (reference utils/distributed.py:44-87 uses mpi4py broadcast; the OMPI env
+    carries the same facts without an MPI dependency)."""
+    rank = int(os.environ["OMPI_COMM_WORLD_RANK"])
+    world_size = int(os.environ["OMPI_COMM_WORLD_SIZE"])
+    local_rank = int(os.environ.get("OMPI_COMM_WORLD_LOCAL_RANK", 0))
+
+    master_addr = os.environ.get("MASTER_ADDR")
+    if master_addr is None:
+        # Without mpi4py we cannot broadcast rank-0's hostname; require the
+        # launcher to provide MASTER_ADDR for multi-node MPI runs.
+        master_addr = "127.0.0.1"
+
+    os.environ["RANK"] = str(rank)
+    os.environ["WORLD_SIZE"] = str(world_size)
+    os.environ["LOCAL_RANK"] = str(local_rank)
+    os.environ["MASTER_ADDR"] = master_addr
+    os.environ.setdefault("MASTER_PORT", str(distributed_port))
+
+    if verbose:
+        logger.info(
+            "Discovered MPI settings of world_rank={}, local_rank={}, "
+            "world_size={}, master_addr={}, master_port={}".format(
+                rank, local_rank, world_size, master_addr,
+                os.environ["MASTER_PORT"]))

@@ -1,0 +1,11 @@
+"""Version info for deepspeed_tpu.
+
+Mirrors the surface of the reference's git_version_info
+(/root/reference/deepspeed/git_version_info.py:1-17) without install-time codegen.
+"""
+
+version = "0.3.10+tpu.r1"
+git_hash = "unknown"
+git_branch = "main"
+installed_ops = {}
+compatible_ops = {}

@@ -1,0 +1,41 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference simulates multi-GPU with forked torch.multiprocessing workers
+(tests/unit/common.py:16-106). The TPU-native equivalent is a single-process
+multi-device mesh: XLA's host platform exposes 8 virtual CPU devices, so every
+sharding/collective path (ZeRO, pipeline, tensor-parallel) compiles and runs
+exactly as it would on an 8-chip slice — no processes to fork, no hangs to
+watch for.
+
+Env vars must be set before jax is imported anywhere; conftest import time is
+the earliest hook pytest gives us.
+"""
+
+import os
+
+# Force-set (the axon/TPU env presets JAX_PLATFORMS and XLA_FLAGS, and jax is
+# partially imported at interpreter startup by sitecustomize, so the env var
+# alone is not enough — jax.config must be updated too).
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", \
+    "tests must run on the virtual CPU mesh, got {}".format(jax.default_backend())
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def eight_devices():
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devices
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "tpu_only: requires real TPU hardware")

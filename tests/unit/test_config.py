@@ -1,0 +1,165 @@
+"""Config-system semantics tests (mirroring reference tests/unit/test_config.py
+and test_ds_config.py): batch triangle, duplicate keys, zero parsing."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+def basic_config(extra=None, **batch):
+    cfg = {"optimizer": {"type": "adam", "params": {"lr": 1e-3}}}
+    cfg.update(batch)
+    if extra:
+        cfg.update(extra)
+    return cfg
+
+
+def test_batch_triangle_all_given():
+    cfg = DeepSpeedConfig(None, param_dict=basic_config(
+        train_batch_size=32,
+        train_micro_batch_size_per_gpu=4,
+        gradient_accumulation_steps=8), world_size=1)
+    assert cfg.train_batch_size == 32
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 8
+
+
+def test_batch_triangle_infer_gas():
+    cfg = DeepSpeedConfig(None, param_dict=basic_config(
+        train_batch_size=32, train_micro_batch_size_per_gpu=4), world_size=2)
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_triangle_infer_micro():
+    cfg = DeepSpeedConfig(None, param_dict=basic_config(
+        train_batch_size=32, gradient_accumulation_steps=4), world_size=2)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_batch_triangle_infer_train():
+    cfg = DeepSpeedConfig(None, param_dict=basic_config(
+        train_micro_batch_size_per_gpu=4, gradient_accumulation_steps=4),
+        world_size=2)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_triangle_only_train():
+    cfg = DeepSpeedConfig(None, param_dict=basic_config(train_batch_size=32),
+                          world_size=2)
+    assert cfg.train_micro_batch_size_per_gpu == 16
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triangle_only_micro():
+    cfg = DeepSpeedConfig(None, param_dict=basic_config(
+        train_micro_batch_size_per_gpu=4), world_size=2)
+    assert cfg.train_batch_size == 8
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triangle_mismatch_asserts():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(None, param_dict=basic_config(
+            train_batch_size=33,
+            train_micro_batch_size_per_gpu=4,
+            gradient_accumulation_steps=8), world_size=1)
+
+
+def test_batch_none_asserts():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(None, param_dict=basic_config(), world_size=1)
+
+
+def test_duplicate_json_keys_rejected(tmp_path):
+    path = tmp_path / "ds_config.json"
+    path.write_text(
+        '{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(path), world_size=1)
+
+
+def test_json_file_load(tmp_path):
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps(basic_config(train_batch_size=16)))
+    cfg = DeepSpeedConfig(str(path), world_size=4)
+    assert cfg.train_batch_size == 16
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_zero_config_dict_form():
+    cfg = DeepSpeedConfig(None, param_dict=basic_config(extra={
+        "zero_optimization": {"stage": 2, "cpu_offload": True,
+                              "reduce_bucket_size": 12345},
+        "fp16": {"enabled": True},
+    }, train_batch_size=8), world_size=1)
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 2
+    assert cfg.zero_config.cpu_offload is True
+    assert cfg.zero_config.reduce_bucket_size == 12345
+    assert cfg.zero_config.allgather_partitions is True  # default
+
+
+def test_zero_deprecated_bool_form():
+    cfg = DeepSpeedConfig(None, param_dict=basic_config(extra={
+        "zero_optimization": True,
+        "fp16": {"enabled": True},
+    }, train_batch_size=8), world_size=1)
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 1
+
+
+def test_zero_requires_mixed_precision():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(None, param_dict=basic_config(extra={
+            "zero_optimization": {"stage": 1},
+        }, train_batch_size=8), world_size=1)
+    # bf16 satisfies it (TPU delta)
+    cfg = DeepSpeedConfig(None, param_dict=basic_config(extra={
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+    }, train_batch_size=8), world_size=1)
+    assert cfg.zero_enabled
+
+
+def test_fp16_dynamic_loss_scale_args():
+    cfg = DeepSpeedConfig(None, param_dict=basic_config(extra={
+        "fp16": {"enabled": True, "initial_scale_power": 16,
+                 "loss_scale_window": 500, "hysteresis": 3,
+                 "min_loss_scale": 2},
+    }, train_batch_size=8), world_size=1)
+    assert cfg.fp16_enabled
+    assert cfg.loss_scale == 0  # dynamic
+    args = cfg.dynamic_loss_scale_args
+    assert args["INITIAL_LOSS_SCALE"] == 2 ** 16
+    assert args["SCALE_WINDOW"] == 500
+    assert args["DELAYED_SHIFT"] == 3
+    assert args["MIN_LOSS_SCALE"] == 2
+
+
+def test_sparse_attention_modes():
+    for mode in ["dense", "fixed", "variable", "bigbird", "bslongformer"]:
+        cfg = DeepSpeedConfig(None, param_dict=basic_config(extra={
+            "sparse_attention": {"mode": mode, "block": 32},
+        }, train_batch_size=8), world_size=1)
+        assert cfg.sparse_attention["mode"] == mode
+        assert cfg.sparse_attention["block"] == 32
+
+
+def test_pipeline_config_defaults():
+    cfg = DeepSpeedConfig(None, param_dict=basic_config(train_batch_size=8),
+                          world_size=1)
+    assert cfg.pipeline == {"stages": "auto", "partition": "best",
+                            "seed_layers": False,
+                            "activation_checkpoint_interval": 0}
+
+
+def test_scheduler_config():
+    cfg = DeepSpeedConfig(None, param_dict=basic_config(extra={
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_min_lr": 0, "warmup_max_lr": 0.001,
+                                 "warmup_num_steps": 10}},
+    }, train_batch_size=8), world_size=1)
+    assert cfg.scheduler_name == "WarmupLR"
+    assert cfg.scheduler_params["warmup_num_steps"] == 10

@@ -1,0 +1,129 @@
+"""Elasticity candidate-batch math (mirrors reference tests/unit/test_elastic.py)."""
+
+import pytest
+
+import deepspeed_tpu.elasticity as elasticity
+from deepspeed_tpu.version import version as ds_version
+
+base_ds_config = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_basic_10k():
+    ds_config = {"elasticity": dict(base_ds_config["elasticity"])}
+    final_batch_size, valid_gpus = elasticity.compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version=ds_version)
+    for gpu_num in valid_gpus:
+        assert final_batch_size % gpu_num == 0
+        batch_per_gpu = final_batch_size // gpu_num
+        found_valid_mbsize = any(
+            batch_per_gpu % mb == 0
+            for mb in ds_config["elasticity"]["micro_batch_sizes"])
+        assert found_valid_mbsize, "No valid mb found for gpu count {}".format(
+            gpu_num)
+
+
+def test_world_size_in_valid():
+    ds_config = {"elasticity": dict(base_ds_config["elasticity"])}
+    final_batch_size, valid_gpus = elasticity.compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version=ds_version)
+    ws = valid_gpus[0]
+    fb2, vg2, mbsize = elasticity.compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version=ds_version,
+        world_size=ws)
+    assert fb2 == final_batch_size
+    assert (fb2 // ws) % mbsize == 0
+
+
+def test_invalid_world_size():
+    ds_config = {"elasticity": dict(base_ds_config["elasticity"])}
+    _, valid_gpus = elasticity.compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version=ds_version)
+    bad_ws = max(valid_gpus) + 1
+    while bad_ws in valid_gpus:
+        bad_ws += 1
+    with pytest.raises(elasticity.ElasticityIncompatibleWorldSize):
+        elasticity.compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version=ds_version,
+            world_size=bad_ws)
+
+
+def test_disabled_raises():
+    ds_config = {"elasticity": dict(base_ds_config["elasticity"])}
+    ds_config["elasticity"]["enabled"] = False
+    with pytest.raises(elasticity.ElasticityConfigError):
+        elasticity.compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version=ds_version)
+
+
+def test_missing_fields_raise():
+    with pytest.raises(elasticity.ElasticityConfigError):
+        elasticity.compute_elastic_config(
+            ds_config={"elasticity": {"enabled": True}},
+            target_deepspeed_version=ds_version)
+
+
+def test_invalid_version_raises():
+    ds_config = {"elasticity": dict(base_ds_config["elasticity"])}
+    ds_config["elasticity"]["version"] = 0.2
+    with pytest.raises(elasticity.ElasticityConfigError):
+        elasticity.compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version=ds_version)
+
+
+def test_future_micro_batches():
+    ds_config = {"elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 4,
+        "micro_batch_sizes": [1, 2, 4],
+        "min_gpus": 1,
+        "max_gpus": 4,
+        "version": 0.1,
+    }}
+    final_batch_size, valid_gpus = elasticity.compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version=ds_version)
+    assert final_batch_size == 4
+    assert valid_gpus == [1, 2, 4]
+
+
+def test_config_in_ds_config_overrides(tmp_path):
+    """DeepSpeedConfig picks up elastic batch params."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    ds_config = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 4,
+            "micro_batch_sizes": [1, 2, 4],
+            "min_gpus": 1,
+            "max_gpus": 4,
+            "version": 0.1,
+        },
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+    }
+    cfg = DeepSpeedConfig(None, param_dict=ds_config, world_size=2)
+    assert cfg.elasticity_enabled
+    assert cfg.train_batch_size == 4
+
+
+def test_batch_params_with_elasticity_raises():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    ds_config = {
+        "train_batch_size": 8,
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 4,
+            "micro_batch_sizes": [1, 2, 4],
+            "version": 0.1,
+        },
+    }
+    with pytest.raises(elasticity.ElasticityConfigError):
+        DeepSpeedConfig(None, param_dict=ds_config, world_size=2)

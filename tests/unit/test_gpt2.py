@@ -1,0 +1,76 @@
+"""Flagship GPT-2 model: trains under the engine, loss decreases, ZeRO shards."""
+
+import numpy as np
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+
+def make_batch(batch, seq, vocab, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, vocab, size=(batch, seq))
+    return ids, ids.copy()
+
+
+def test_gpt2_tiny_trains():
+    cfg = GPT2Config.tiny()
+    model = GPT2LMHeadModel(cfg)
+    engine, _, _, _ = deepspeed.initialize(
+        model=model,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW",
+                          "params": {"lr": 1e-3, "weight_decay": 0.01}},
+            "bf16": {"enabled": True},
+        })
+    losses = []
+    for i in range(10):
+        ids, labels = make_batch(8, 32, cfg.vocab_size, seed=i % 2)
+        loss = engine(ids, labels)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_gpt2_zero2_fused(eight_devices):
+    cfg = GPT2Config.tiny()
+    model = GPT2LMHeadModel(cfg)
+    engine, _, _, _ = deepspeed.initialize(
+        model=model,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2},
+        })
+    losses = []
+    for i in range(10):
+        ids, labels = make_batch(8, 32, cfg.vocab_size, seed=i % 2)
+        loss = engine.train_batch(batch=(ids, labels))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # optimizer moments must actually be sharded over the data axis
+    import jax
+    sharded = [
+        x for x in jax.tree_util.tree_leaves(engine.opt_state["exp_avg"])
+        if not x.sharding.is_fully_replicated
+    ]
+    assert len(sharded) > 0, "ZeRO-2: no optimizer state sharded"
+
+
+def test_gpt2_remat():
+    cfg = GPT2Config.tiny(remat=True)
+    model = GPT2LMHeadModel(cfg)
+    engine, _, _, _ = deepspeed.initialize(
+        model=model,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True},
+        })
+    ids, labels = make_batch(8, 32, cfg.vocab_size)
+    loss = engine(ids, labels)
+    engine.backward(loss)
+    engine.step()
+    assert np.isfinite(float(loss))

@@ -1,0 +1,123 @@
+"""LR schedule behavior tests (mirrors reference tests/unit/test_lr_schedulers.py)."""
+
+import math
+
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRRangeTest,
+    OneCycle,
+    WarmupDecayLR,
+    WarmupLR,
+)
+
+
+class FakeOptimizer:
+    def __init__(self, lr=0.0, betas=(0.9, 0.99), groups=1):
+        self.param_groups = [{"lr": lr, "betas": betas} for _ in range(groups)]
+
+
+def test_warmup_lr():
+    opt = FakeOptimizer()
+    sched = WarmupLR(opt, warmup_min_lr=0.0, warmup_max_lr=0.1,
+                     warmup_num_steps=10)
+    lrs = []
+    for _ in range(15):
+        sched.step()
+        lrs.append(opt.param_groups[0]["lr"])
+    # Monotonic warmup then flat at max
+    assert all(b >= a for a, b in zip(lrs, lrs[1:]))
+    assert lrs[-1] == pytest.approx(0.1)
+    assert lrs[10] == pytest.approx(0.1)
+
+
+def test_warmup_decay_lr():
+    opt = FakeOptimizer()
+    sched = WarmupDecayLR(opt, total_num_steps=20, warmup_min_lr=0.0,
+                          warmup_max_lr=0.1, warmup_num_steps=10)
+    lrs = []
+    for _ in range(21):
+        sched.step()
+        lrs.append(opt.param_groups[0]["lr"])
+    peak = max(lrs)
+    assert peak == pytest.approx(0.1, rel=1e-3)
+    # decays linearly to 0 at total_num_steps (last_batch_iteration==20)
+    assert lrs[-1] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_warmup_gamma_log_shape():
+    opt = FakeOptimizer()
+    sched = WarmupLR(opt, warmup_min_lr=0.0, warmup_max_lr=1.0,
+                     warmup_num_steps=100)
+    sched.step(9)  # last_batch_iteration = 9
+    expected = math.log(10) / math.log(100)
+    assert opt.param_groups[0]["lr"] == pytest.approx(expected)
+
+
+def test_lr_range_test_continuous():
+    opt = FakeOptimizer()
+    sched = LRRangeTest(opt, lr_range_test_min_lr=0.01,
+                        lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0)
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.01)
+    for _ in range(10):
+        sched.step()
+    # after 10 steps, interval = 10/10 = 1 → lr = 0.01 * (1 + 1) = 0.02
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.02)
+
+
+def test_lr_range_test_staircase():
+    opt = FakeOptimizer()
+    sched = LRRangeTest(opt, lr_range_test_min_lr=0.01,
+                        lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0,
+                        lr_range_test_staircase=True)
+    lrs = set()
+    for _ in range(9):
+        sched.step()
+        lrs.add(round(opt.param_groups[0]["lr"], 8))
+    assert len(lrs) == 1  # constant within the stair
+
+
+def test_one_cycle_triangle():
+    opt = FakeOptimizer()
+    sched = OneCycle(opt, cycle_min_lr=0.01, cycle_max_lr=0.1,
+                     cycle_first_step_size=10, cycle_momentum=False)
+    lrs = []
+    for _ in range(20):
+        sched.step()
+        lrs.append(opt.param_groups[0]["lr"])
+    peak_idx = lrs.index(max(lrs))
+    assert 8 <= peak_idx <= 10
+    assert max(lrs) == pytest.approx(0.1, rel=0.05)
+    # decreasing second half
+    assert lrs[-1] < max(lrs)
+
+
+def test_one_cycle_momentum_inverse():
+    opt = FakeOptimizer()
+    sched = OneCycle(opt, cycle_min_lr=0.01, cycle_max_lr=0.1,
+                     cycle_first_step_size=10, cycle_momentum=True,
+                     cycle_min_mom=0.8, cycle_max_mom=0.9)
+    moms, lrs = [], []
+    for _ in range(10):
+        sched.step()
+        lrs.append(opt.param_groups[0]["lr"])
+        moms.append(opt.param_groups[0]["betas"][0])
+    # momentum falls while lr rises
+    assert lrs[-1] > lrs[0]
+    assert moms[-1] < moms[0]
+
+
+def test_state_dict_roundtrip():
+    opt = FakeOptimizer()
+    sched = WarmupLR(opt, warmup_max_lr=0.1, warmup_num_steps=10)
+    for _ in range(5):
+        sched.step()
+    sd = sched.state_dict()
+    opt2 = FakeOptimizer()
+    sched2 = WarmupLR(opt2, warmup_max_lr=0.1, warmup_num_steps=10)
+    sched2.load_state_dict(sd)
+    sched.step()
+    sched2.step()
+    assert opt.param_groups[0]["lr"] == pytest.approx(opt2.param_groups[0]["lr"])
