@@ -1,9 +1,2 @@
 """Pipeline-parallelism re-exports (reference deepspeed/pipe/__init__.py)."""
-try:
-    from deepspeed_tpu.runtime.pipe import LayerSpec, PipelineModule, TiedLayerSpec
-except ImportError:  # pipeline engine lands in a later milestone
-    class PipelineModule:  # placeholder so isinstance checks work
-        _placeholder = True
-
-    LayerSpec = None
-    TiedLayerSpec = None
+from deepspeed_tpu.runtime.pipe import LayerSpec, PipelineModule, TiedLayerSpec

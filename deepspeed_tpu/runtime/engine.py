@@ -102,7 +102,7 @@ class DeepSpeedEngine(object):
 
         # Device mesh: the TPU-native replacement for process groups.
         self.mesh = mesh if mesh is not None else mesh_lib.build_mesh()
-        self.dp_world_size = mesh_lib.dp_size(self.mesh)
+        self.dp_world_size = self._config_world_size()
         self.mp_world_size = mesh_lib.mp_size(self.mesh)
         self.world_size = self.dp_world_size
         self.global_rank = 0
@@ -169,6 +169,11 @@ class DeepSpeedEngine(object):
             self._dump_state()
 
     # ------------------------------------------------------------------ config
+
+    def _config_world_size(self):
+        """Data-parallel world size used for batch-triangle math. The
+        PipelineEngine overrides this (its executor is dp=1 within stages)."""
+        return mesh_lib.dp_size(self.mesh)
 
     def _configure_with_arguments(self, args, config_params):
         config_file = getattr(args, "deepspeed_config", None) if args else None

@@ -1,0 +1,294 @@
+"""N-D cartesian rank topology + pipeline grid.
+
+Behavior-parity port of reference runtime/pipe/topology.py:12-455. The
+coordinate math (ProcessTopology, axis comm lists, rank filtering) is pure
+Python and identical in behavior. ``PipelineParallelGrid`` diverges in its
+backend: instead of building torch.distributed process groups per axis
+(topology.py:281-372), it records the rank lists AND maps them onto a
+``jax.sharding.Mesh`` whose ('pipe','data','model') axes carry the collectives
+— a "process group" on TPU is just a named mesh axis.
+"""
+
+from collections import namedtuple
+from itertools import product as cartesian_product
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class ProcessTopology:
+    """Maps n-dimensional cartesian coordinates to linear rank indices.
+
+    Row-major layout: axes=['x','y'] puts (x,y) and (x,y+1) at adjacent
+    linear indices.
+    """
+
+    def __init__(self, axes, dims):
+        self.axes = axes
+        self.dims = dims
+        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+        self.mapping = {}
+        ranges = [range(d) for d in dims]
+        for global_rank, coord in enumerate(cartesian_product(*ranges)):
+            key = {axis: coord[self.axes.index(axis)] for axis in self.axes}
+            key = self.ProcessCoord(**key)
+            self.mapping[key] = global_rank
+
+    def get_rank(self, **coord_kwargs):
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(
+                "get_rank() does not support slices. Use filter_match()")
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, "key {} invalid".format(coord_kwargs)
+        return self.mapping[key]
+
+    def get_axis_names(self):
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=("data", "pipe"),
+                      inner_sep="_", outer_sep="-"):
+        """String representation of a rank, used for checkpoint file names."""
+        omit_axes = frozenset(omit_axes)
+        axes = [a for a in self.get_axis_names() if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append("{}{}{:02d}".format(ax, inner_sep, ax_rank))
+        return outer_sep.join(names)
+
+    def get_dim(self, axis):
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank):
+        for coord, idx in self.mapping.items():
+            if idx == rank:
+                return coord
+        raise ValueError("rank {} not found in topology.".format(rank))
+
+    def get_axis_comm_lists(self, axis):
+        """Rank lists that differ only along ``axis`` — communicator groups."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for coord in cartesian_product(*ranges):
+            other_keys = {a: coord[other_axes.index(a)] for a in other_axes}
+            sub_list = []
+            for axis_key in range(self.get_dim(axis)):
+                key = self.ProcessCoord(**other_keys, **{axis: axis_key})
+                sub_list.append(self.mapping[key])
+            lists.append(sub_list)
+        return lists
+
+    def filter_match(self, **filter_kwargs):
+        """Ranks whose coordinates match all given axis=value criteria."""
+        def _filter_helper(x):
+            for key, val in filter_kwargs.items():
+                if getattr(x, key) != val:
+                    return False
+            return True
+
+        coords = filter(_filter_helper, self.mapping.keys())
+        return [self.mapping[coo] for coo in coords]
+
+    def get_axis_list(self, axis, idx):
+        axis_num = self.axes.index(axis)
+        return [self.mapping[k] for k in self.mapping.keys()
+                if k[axis_num] == idx]
+
+    def world_size(self):
+        return len(self.mapping)
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+def _prime_factors(N):
+    """Prime factorization of a positive integer (reference topology.py:223-233)."""
+    if N <= 0:
+        raise ValueError("Values must be strictly positive.")
+    primes = []
+    while N != 1:
+        for candidate in range(2, N + 1):
+            if N % candidate == 0:
+                primes.append(candidate)
+                N //= candidate
+                break
+    return primes
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """Hybrid data+pipeline topology: data on the last (fast) dimension so
+    gradient reductions ride high-bandwidth links (reference topology.py:235-244)."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """Hybrid pipeline+model+data topology (reference topology.py:246-249)."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"],
+                         dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """2-D (stage_id × data_parallel_id) grid over a topology; exposes the
+    Megatron-style mpu interface (reference topology.py:252-455).
+
+    On TPU, "building a process group" = recording the rank list; collectives
+    execute over named mesh axes. ``global_rank`` defaults to 0 in
+    single-controller mode where one process drives all chips — per-rank views
+    are available via ``set_rank`` for schedule construction.
+    """
+
+    def __init__(self, topology=None, process_group=None, world_size=None,
+                 global_rank=0):
+        self.global_rank = global_rank
+        if topology is not None:
+            self._topo = topology
+            self.world_size = topology.world_size()
+        else:
+            assert world_size is not None, \
+                "PipelineParallelGrid needs a topology or world_size"
+            self.world_size = world_size
+            num_pp, num_dp = self._infer_grid(world_size)
+            self._topo = PipeDataParallelTopology(num_pp=num_pp, num_dp=num_dp)
+
+        self.data_parallel_size = max(self._topo.get_dim("data"), 1)
+        self.pipe_parallel_size = max(self._topo.get_dim("pipe"), 1)
+        self.model_parallel_size = max(self._topo.get_dim("model"), 1)
+        assert self.world_size == (self.data_parallel_size *
+                                   self.pipe_parallel_size *
+                                   self.model_parallel_size)
+
+        self.stage_id = self.get_stage_id()
+        self.data_parallel_id = self.get_data_parallel_id()
+
+        # Rank lists per axis (the reference's process groups, as data).
+        self.dp_groups = self._topo.get_axis_comm_lists("data")
+        self.pp_groups = self._topo.get_axis_comm_lists("pipe")
+        self.mp_groups = self._topo.get_axis_comm_lists("model") or \
+            [[r] for r in range(self.world_size)]
+        self.p2p_groups = self._build_p2p_groups()
+
+        # Slice groups: ranks that together hold one replica of the model
+        # (pipe × model), used for PartitionedTensor activation sharding.
+        self.slice_groups = []
+        for dp in range(self.data_parallel_size):
+            ranks = sorted(self._topo.filter_match(data=dp))
+            self.slice_groups.append(ranks)
+
+        self.slice_parallel_size = self.model_parallel_size
+
+    def _infer_grid(self, world_size):
+        """Alternate prime factors between pipe and data dims
+        (reference topology.py:282-288): world_size=8 → pp=4, dp=2."""
+        num_pp = 1
+        num_dp = 1
+        for idx, prime in enumerate(_prime_factors(world_size)):
+            if idx % 2 == 0:
+                num_pp *= prime
+            else:
+                num_dp *= prime
+        return num_pp, num_dp
+
+    def set_rank(self, rank):
+        """Re-view the grid from a specific global rank (used when iterating
+        stages in single-controller mode)."""
+        self.global_rank = rank
+        self.stage_id = self.get_stage_id()
+        self.data_parallel_id = self.get_data_parallel_id()
+        return self
+
+    def get_stage_id(self):
+        return self._topo.get_coord(rank=self.global_rank).pipe
+
+    def get_data_parallel_id(self):
+        return self._topo.get_coord(rank=self.global_rank).data
+
+    def _build_p2p_groups(self):
+        """Stage-adjacent rank pairs, with wrap-around (reference :372-409)."""
+        comm_lists = self._topo.get_axis_comm_lists("pipe")
+        p2p_lists = []
+        for rank in range(self.world_size):
+            for lst in comm_lists:
+                if rank in lst:
+                    idx = lst.index(rank)
+                    buddy_rank = lst[(idx + 1) % self.pipe_parallel_size]
+                    p2p_lists.append([rank, buddy_rank])
+                    break
+        assert len(p2p_lists) == self.world_size
+        return p2p_lists
+
+    def topology(self):
+        return self._topo
+
+    # ---- Megatron mpu compatibility interface (reference :411-455) ----
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    def get_pipe_parallel_rank(self):
+        return self.stage_id
+
+    def get_pipe_parallel_world_size(self):
+        return self.pipe_parallel_size
+
+    def get_pipe_parallel_group(self):
+        for ranks in self.pp_groups:
+            if self.global_rank in ranks:
+                return ranks
+        return None
+
+    def get_data_parallel_rank(self):
+        return self.data_parallel_id
+
+    def get_data_parallel_world_size(self):
+        return self.data_parallel_size
+
+    def get_data_parallel_group(self):
+        for ranks in self.dp_groups:
+            if self.global_rank in ranks:
+                return ranks
+        return None
+
+    def get_model_parallel_rank(self):
+        if "model" in self._topo.get_axis_names():
+            return self._topo.get_coord(rank=self.global_rank).model
+        return 0
+
+    def get_model_parallel_world_size(self):
+        return self.model_parallel_size
+
+    def get_model_parallel_group(self):
+        for ranks in self.mp_groups:
+            if self.global_rank in ranks:
+                return ranks
+        return None
+
+    def get_slice_parallel_rank(self):
+        return self.get_model_parallel_rank()
+
+    def get_slice_parallel_world_size(self):
+        return self.slice_parallel_size
+
+    def get_slice_parallel_group(self):
+        for ranks in self.slice_groups:
+            if self.global_rank in ranks:
+                return ranks
+        return None
+
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    def is_last_stage(self):
+        return self.stage_id == self.pipe_parallel_size - 1
+
+    def stage_to_global(self, stage_id, **kwargs):
+        me = self._topo.get_coord(self.global_rank)
+        transform = me._replace(pipe=stage_id, **kwargs)._asdict()
+        return self._topo.get_rank(**transform)

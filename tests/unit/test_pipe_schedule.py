@@ -1,0 +1,133 @@
+"""Schedule instruction-sequence tests, no devices needed (mirrors reference
+tests/unit/test_pipe_schedule.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe import schedule
+
+
+def _count_type(cmds, classtype):
+    return len([c for c in cmds if isinstance(c, classtype)])
+
+
+def test_pipe_inference_schedule_singlestage():
+    sched = schedule.InferenceSchedule(micro_batches=4, stages=1, stage_id=0)
+    assert sched.num_pipe_buffers() == 2
+    for step_id, cmds in enumerate(sched):
+        assert len(cmds) == 2
+        assert isinstance(cmds[0], schedule.LoadMicroBatch)
+        assert isinstance(cmds[1], schedule.ForwardPass)
+        assert cmds[0].buffer_id == cmds[1].buffer_id
+    assert len(list(iter(sched))) == 4
+
+
+def test_pipe_train_schedule_singlestage():
+    # one stage: 1F1B degenerates to alternating F0 B0 F1 B1 ...
+    sched = schedule.TrainSchedule(micro_batches=4, stages=1, stage_id=0)
+    for step_id, cmds in enumerate(sched):
+        if step_id % 2 == 0:
+            assert _count_type(cmds, schedule.LoadMicroBatch) == 1
+            assert _count_type(cmds, schedule.ForwardPass) == 1
+        else:
+            assert _count_type(cmds, schedule.BackwardPass) == 1
+        if step_id == 2 * sched.micro_batches - 1:
+            assert _count_type(cmds, schedule.ReduceTiedGrads) == 1
+            assert _count_type(cmds, schedule.ReduceGrads) == 1
+            assert _count_type(cmds, schedule.OptimizerStep) == 1
+
+
+@pytest.mark.parametrize("micro_batches", [1, 3, 8, 10])
+def test_pipe_inference_schedule_firststage(micro_batches, stages=3):
+    sched = schedule.InferenceSchedule(micro_batches=micro_batches,
+                                       stages=stages,
+                                       stage_id=0)
+    assert sched.num_pipe_buffers() == 2
+    for step_id, cmds in enumerate(sched):
+        if step_id < sched.micro_batches:
+            assert _count_type(cmds, schedule.LoadMicroBatch) == 1
+            assert _count_type(cmds, schedule.ForwardPass) == 1
+        # no recvs on first stage
+        assert _count_type(cmds, schedule.RecvActivation) == 0
+    total_steps = len(list(iter(sched)))
+    assert total_steps == micro_batches + stages - 1
+
+
+@pytest.mark.parametrize("micro_batches", [1, 3, 8, 10])
+def test_pipe_inference_schedule_laststage(micro_batches, stages=3):
+    sched = schedule.InferenceSchedule(micro_batches=micro_batches,
+                                       stages=stages,
+                                       stage_id=stages - 1)
+    for step_id, cmds in enumerate(sched):
+        # no sends on last stage
+        assert _count_type(cmds, schedule.SendActivation) == 0
+    total_steps = len(list(iter(sched)))
+    assert total_steps == micro_batches + stages - 1
+
+
+def test_pipe_schedule_firststage_train():
+    sched = schedule.TrainSchedule(micro_batches=8, stages=3, stage_id=0)
+    for cmds in sched:
+        assert all(not isinstance(c, schedule.RecvActivation) for c in cmds)
+        assert all(not isinstance(c, schedule.SendGrad) for c in cmds)
+
+
+def test_pipe_schedule_laststage_train():
+    sched = schedule.TrainSchedule(micro_batches=8, stages=3, stage_id=2)
+    for cmds in sched:
+        assert all(not isinstance(c, schedule.SendActivation) for c in cmds)
+        assert all(not isinstance(c, schedule.RecvGrad) for c in cmds)
+
+
+def test_train_schedule_total_steps():
+    m, s = 6, 4
+    for stage in range(s):
+        sched = schedule.TrainSchedule(micro_batches=m, stages=s,
+                                       stage_id=stage)
+        assert len(list(iter(sched))) == 2 * (m + s - 1)
+
+
+def test_train_schedule_buffer_count_floor():
+    # buffer count = max(2, min(stages - stage_id + 1, micro_batches))
+    sched = schedule.TrainSchedule(micro_batches=1, stages=4, stage_id=3)
+    assert sched.num_pipe_buffers() == 2
+    sched = schedule.TrainSchedule(micro_batches=8, stages=4, stage_id=0)
+    assert sched.num_pipe_buffers() == 5
+    sched = schedule.TrainSchedule(micro_batches=8, stages=4, stage_id=3)
+    assert sched.num_pipe_buffers() == 2
+
+
+def test_train_schedule_all_microbatches_forward_and_backward():
+    """Every stage must forward and backward every micro-batch exactly once."""
+    m, s = 5, 3
+    for stage in range(s):
+        sched = schedule.TrainSchedule(micro_batches=m, stages=s,
+                                       stage_id=stage)
+        fwd = bwd = 0
+        for cmds in sched:
+            fwd += _count_type(cmds, schedule.ForwardPass)
+            bwd += _count_type(cmds, schedule.BackwardPass)
+        assert fwd == m
+        assert bwd == m
+
+
+def test_send_recv_pairing():
+    """Sends at stage s and recvs at stage s+1 must pair within steps (the
+    atomic-step property the executor relies on)."""
+    m, s = 4, 3
+    scheds = [schedule.TrainSchedule(micro_batches=m, stages=s, stage_id=i)
+              for i in range(s)]
+    steps = [list(sc.steps()) for sc in scheds]
+    sends = {i: 0 for i in range(s)}
+    recvs = {i: 0 for i in range(s)}
+    for step_id in range(len(steps[0])):
+        for i in range(s):
+            for cmd in steps[i][step_id]:
+                if isinstance(cmd, schedule.SendActivation):
+                    sends[i] += 1
+                if isinstance(cmd, schedule.RecvActivation):
+                    recvs[i] += 1
+        # cumulative recvs at stage i+1 never exceed cumulative sends at i
+        for i in range(s - 1):
+            assert recvs[i + 1] <= sends[i]
+    for i in range(s - 1):
+        assert sends[i] == recvs[i + 1] == m

@@ -1,0 +1,111 @@
+"""Topology / grid math tests, pure CPU (mirrors reference tests/unit/test_topology.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe.topology import (
+    PipeDataParallelTopology,
+    PipelineParallelGrid,
+    PipeModelDataParallelTopology,
+    ProcessTopology,
+)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+    assert topo.get_axis_list(axis="row", idx=0) == [0, 1]
+    assert topo.get_axis_list(axis="row", idx=1) == [2, 3]
+    assert topo.get_axis_list(axis="col", idx=0) == [0, 2]
+    assert topo.get_axis_list(axis="col", idx=1) == [1, 3]
+
+
+def test_topology_dims():
+    topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 3, 4])
+    assert topo.world_size() == 24
+    assert topo.get_dim("a") == 2
+    assert topo.get_dim("b") == 3
+    assert topo.get_dim("c") == 4
+    assert topo.get_dim("missing") == 0
+
+
+def test_topology_rank_repr():
+    topo = ProcessTopology(axes=["a", "b"], dims=[2, 2])
+    assert topo.get_rank_repr(rank=0, omit_axes=[]) == "a_00-b_00"
+    assert topo.get_rank_repr(rank=3, omit_axes=[]) == "a_01-b_01"
+    assert topo.get_rank_repr(rank=3, omit_axes=["a"]) == "b_01"
+    # default omits data/pipe
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 2])
+    assert topo.get_rank_repr(rank=0) == ""
+    topo = ProcessTopology(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    assert topo.get_rank_repr(rank=1) == "model_01"
+
+
+def test_topology_comm_lists():
+    topo = ProcessTopology(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    assert topo.get_axis_comm_lists("pipe") == [
+        [0, 4], [1, 5], [2, 6], [3, 7]]
+    assert topo.get_axis_comm_lists("data") == [
+        [0, 2], [1, 3], [4, 6], [5, 7]]
+    assert topo.get_axis_comm_lists("model") == [
+        [0, 1], [2, 3], [4, 5], [6, 7]]
+    assert topo.get_axis_comm_lists("jeff") == []
+
+
+def test_topology_filter_match():
+    topo = ProcessTopology(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    assert topo.filter_match(pipe=0, data=1) == [2, 3]
+    assert topo.filter_match(model=1) == [1, 3, 5, 7]
+
+
+def test_pipe_data_topology():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    assert topo.world_size() == 8
+    # data is the fast axis
+    assert topo.get_axis_list("pipe", 0) == [0, 1, 2, 3]
+
+
+def test_grid_pipe_data():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo)
+    assert grid.pipe_parallel_size == 4
+    assert grid.data_parallel_size == 2
+    assert grid.model_parallel_size == 1
+    # rank 0: pipe 0, data 0
+    assert grid.get_stage_id() == 0
+    assert grid.get_data_parallel_id() == 0
+    # view from rank 3 (pipe=1, data=1)
+    grid.set_rank(3)
+    assert grid.get_stage_id() == 1
+    assert grid.get_data_parallel_id() == 1
+    assert grid.get_data_parallel_world_size() == 2
+    assert grid.get_pipe_parallel_world_size() == 4
+
+
+def test_grid_p2p_groups():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=1)
+    grid = PipelineParallelGrid(topology=topo)
+    # each rank pairs with its next stage, wrap-around at the end
+    assert grid.p2p_groups == [[0, 1], [1, 2], [2, 3], [3, 0]]
+
+
+def test_grid_model_parallel():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo)
+    assert grid.model_parallel_size == 2
+    assert grid.get_model_parallel_rank() == 0
+    grid.set_rank(1)
+    assert grid.get_model_parallel_rank() == 1
+    assert grid.get_slice_parallel_world_size() == 2
+
+
+def test_grid_stage_to_global():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo)
+    grid.set_rank(0)
+    assert grid.stage_to_global(stage_id=1) == 2
+    grid.set_rank(1)
+    assert grid.stage_to_global(stage_id=1) == 3
