@@ -51,16 +51,23 @@ def main():
         })
 
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, size=(batch * jax.device_count(), seq))
+    # Distinct batch per step, like a real input pipeline.
+    batches = [
+        rng.randint(0, cfg.vocab_size, size=(batch * jax.device_count(), seq))
+        for _ in range(steps + 1)
+    ]
 
-    # Warmup/compile
-    loss = engine.train_batch(batch=(ids, ids))
-    jax.block_until_ready(loss)
+    # Warmup/compile. Sync via value fetch, not block_until_ready: on the
+    # remote-device platform used for benching, block_until_ready was
+    # observed returning before execution finished (fetch afterwards still
+    # took seconds); fetching the scalar is a reliable barrier everywhere.
+    loss = engine.train_batch(batch=(batches[0], batches[0]))
+    float(loss)
 
     t0 = time.time()
-    for _ in range(steps):
+    for ids in batches[1:]:
         loss = engine.train_batch(batch=(ids, ids))
-    jax.block_until_ready(loss)
+    loss = float(loss)
     dt = time.time() - t0
 
     tokens = batch * jax.device_count() * seq * steps
@@ -77,7 +84,7 @@ def main():
             "mfu": round(mfu, 4),
             "platform": platform,
             "devices": jax.device_count(),
-            "loss": float(loss),
+            "loss": loss,
             "params": cfg.num_params(),
         },
     }))

@@ -1,2 +1,4 @@
 from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
 from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                           DeepSpeedTransformerLayer)

@@ -1,0 +1,5 @@
+"""Fused transformer layer + Pallas kernels (reference deepspeed/ops/transformer)."""
+
+from deepspeed_tpu.ops.transformer.transformer import (  # noqa: F401
+    DeepSpeedTransformerConfig, DeepSpeedTransformerLayer,
+    transformer_layer_reference)

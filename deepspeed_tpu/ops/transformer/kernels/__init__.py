@@ -1,0 +1,12 @@
+"""Pallas TPU kernels — the native-op tier (reference csrc/transformer)."""
+
+from deepspeed_tpu.ops.transformer.kernels.attention import (  # noqa: F401
+    flash_attention, mha_reference)
+from deepspeed_tpu.ops.transformer.kernels.dropout import (  # noqa: F401
+    dropout, fused_bias_dropout_residual)
+from deepspeed_tpu.ops.transformer.kernels.gelu import (  # noqa: F401
+    bias_gelu_reference, fused_bias_gelu)
+from deepspeed_tpu.ops.transformer.kernels.layer_norm import (  # noqa: F401
+    fused_bias_residual_layer_norm, fused_layer_norm, layer_norm_reference)
+from deepspeed_tpu.ops.transformer.kernels.softmax import (  # noqa: F401
+    attn_softmax, attn_softmax_reference)

@@ -1,0 +1,354 @@
+"""Fused multi-head attention — the TPU-native answer to the reference's
+attention pipeline (reference csrc/transformer/ds_transformer_cuda.cpp:624:
+qkv GEMM -> head split -> score GEMM -> launch_attn_softmax -> attn dropout
+-> ctx GEMM -> head merge).
+
+On GPU the reference fuses softmax/dropout between separate cuBLAS GEMMs,
+materialising the [T, T] score matrix. On TPU the right fusion boundary is
+different: one flash-style Pallas kernel keeps each score block in VMEM and
+never writes the [T, T] matrix to HBM — O(T) memory instead of O(T^2), and
+both GEMMs land on the MXU from the same kernel.
+
+Forward: online-softmax accumulation over key/value blocks.
+Backward: standard two-pass flash backward (one kernel produces dq looping
+over kv blocks; one produces dk/dv looping over q blocks), using the saved
+per-row logsumexp; wired up with jax.custom_vjp.
+
+Off-TPU the kernels run in Pallas interpret mode, so the CPU test mesh
+exercises the same code path (tests mirror reference
+tests/unit/test_cuda_forward.py / test_cuda_backward.py grids).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Reference (pure jnp) implementation — ground truth for parity tests and
+# fallback for shapes the kernel does not support.
+# ---------------------------------------------------------------------------
+
+def mha_reference(q, k, v, mask=None, causal=False, scale=None):
+    """q,k,v: [B, H, T, D]; mask: additive [B, T_kv] (broadcast over heads
+    and query rows, the BERT padding-mask shape)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = s + mask[:, None, None, :].astype(jnp.float32)
+    if causal:
+        t_q, t_k = q.shape[2], k.shape[2]
+        cm = jnp.tril(jnp.ones((t_q, t_k), dtype=bool))
+        s = jnp.where(cm[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(*refs, scale, causal, block_k, has_mask):
+    if has_mask:
+        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        mask_ref = None
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale            # [bq, d]
+    bq, d = q.shape
+    t_kv = k_ref.shape[2]
+    iq = pl.program_id(2)
+    n_kv = pl.cdiv(t_kv, block_k)
+
+    def body(j, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [bq, bk]
+        if mask_ref is not None:
+            s = s + mask_ref[0, pl.ds(j * block_k, block_k)][None, :]
+        if causal:
+            q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    # Under a causal mask, blocks past the diagonal contribute nothing.
+    n_loop = jnp.minimum(n_kv, pl.cdiv((iq + 1) * bq, block_k)) if causal else n_kv
+    acc, m, l = jax.lax.fori_loop(
+        0, n_loop, body,
+        (jnp.zeros((bq, d), jnp.float32),
+         jnp.full((bq, 1), NEG_INF, jnp.float32),
+         jnp.zeros((bq, 1), jnp.float32)))
+
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k):
+    b, h, t_q, d = q.shape
+    t_kv = k.shape[2]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_kv)
+    grid = (b, h, pl.cdiv(t_q, block_q))
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, t_kv, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+        pl.BlockSpec((1, 1, t_kv, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+    ]
+    args = [q, k, v]
+    if mask is not None:
+        in_specs.append(pl.BlockSpec((1, t_kv), lambda b_, h_, i: (b_, 0)))
+        args.append(mask.astype(jnp.float32))
+
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k, has_mask=mask is not None),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i: (b_, h_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, t_q, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*args)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+# delta_i = rowsum(dO_i * O_i); then
+#   dS = P * (dP - delta),  dq = dS K,  dk = dS^T q,  dv = P^T dO
+# P is recomputed blockwise from q, k and the saved lse (never stored).
+
+def _bwd_dq_kernel(*refs, scale, causal, block_k, has_mask):
+    if has_mask:
+        (q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+         dq_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
+        mask_ref = None
+
+    q = q_ref[0, 0].astype(jnp.float32)                    # [bq, d]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]                                    # [bq, 1]
+    delta = delta_ref[0, 0]
+    bq, d = q.shape
+    t_kv = k_ref.shape[2]
+    iq = pl.program_id(2)
+    n_kv = pl.cdiv(t_kv, block_k)
+
+    def body(j, dq):
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if mask_ref is not None:
+            s = s + mask_ref[0, pl.ds(j * block_k, block_k)][None, :]
+        if causal:
+            q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                               # [bq, bk]
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    n_loop = jnp.minimum(n_kv, pl.cdiv((iq + 1) * bq, block_k)) if causal else n_kv
+    dq = jax.lax.fori_loop(0, n_loop, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, has_mask):
+    if has_mask:
+        (q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+         dv_ref) = refs
+        mask_ref = None
+
+    k_blk = k_ref[0, 0].astype(jnp.float32)                # [bk, d]
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    bk, d = k_blk.shape
+    t_q = q_ref.shape[2]
+    jk = pl.program_id(2)
+    n_q = pl.cdiv(t_q, block_q)
+    if mask_ref is not None:
+        mask_blk = mask_ref[0][None, :]                    # [1, bk]
+    else:
+        mask_blk = None
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if mask_blk is not None:
+            s = s + mask_blk
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            k_pos = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                               # [bq, bk]
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # Query blocks strictly above this kv block's diagonal are masked out.
+        start = (jk * bk) // block_q
+    else:
+        start = 0
+    dk, dv = jax.lax.fori_loop(
+        start, n_q, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, scale, causal, block_q, block_k):
+    q, k, v, mask, o, lse = res
+    b, h, t_q, d = q.shape
+    t_kv = k.shape[2]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_kv)
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0))
+    q_full = pl.BlockSpec((1, 1, t_q, d), lambda b_, h_, j: (b_, h_, 0, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j: (b_, h_, j, 0))
+    kv_full = pl.BlockSpec((1, 1, t_kv, d), lambda b_, h_, i: (b_, h_, 0, 0))
+    row_blk = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i: (b_, h_, i, 0))
+    row_full = pl.BlockSpec((1, 1, t_q, 1), lambda b_, h_, j: (b_, h_, 0, 0))
+
+    # dq: grid over q blocks.
+    in_specs = [q_spec, kv_full, kv_full]
+    args = [q, k, v]
+    if mask is not None:
+        in_specs.append(pl.BlockSpec((1, t_kv), lambda b_, h_, i: (b_, 0)))
+        args.append(mask.astype(jnp.float32))
+    in_specs += [q_spec, row_blk, row_blk]
+    args += [do, lse, delta]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, has_mask=mask is not None),
+        grid=(b, h, pl.cdiv(t_q, block_q)),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(*args)
+
+    # dk/dv: grid over kv blocks.
+    in_specs = [q_full, kv_spec, kv_spec]
+    args = [q, k, v]
+    if mask is not None:
+        in_specs.append(pl.BlockSpec((1, block_k), lambda b_, h_, j: (b_, j)))
+        args.append(mask.astype(jnp.float32))
+    in_specs += [q_full, row_full, row_full]
+    args += [do, lse, delta]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, has_mask=mask is not None),
+        grid=(b, h, pl.cdiv(t_kv, block_k)),
+        in_specs=in_specs,
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        interpret=_interpret(),
+    )(*args)
+
+    dmask = None if mask is None else jnp.zeros_like(mask)
+    return dq, dk, dv, dmask
+
+
+# ---------------------------------------------------------------------------
+# Public entry point
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention(q, k, v, mask, scale, causal, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_attention_fwd(q, k, v, mask, scale, causal, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k)
+    return o, (q, k, v, mask, o, lse)
+
+
+def _flash_attention_bwd(scale, causal, block_q, block_k, res, g):
+    return _flash_bwd(res, g, scale, causal, block_q, block_k)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention(q, k, v, mask=None, causal=False, scale=None,
+                    block_q=128, block_k=128):
+    """Fused (flash) multi-head attention.
+
+    Args:
+      q, k, v: [B, H, T, D].
+      mask: optional additive padding mask [B, T_kv] (0 keep / -1e9 drop),
+        broadcast over heads and query rows — the reference's attention-mask
+        convention (csrc/transformer/softmax_kernels.cu attn_softmax).
+      causal: apply a causal (autoregressive) mask.
+      scale: score scale; default 1/sqrt(D).
+    Returns: [B, H, T, D] in q.dtype.
+    """
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    t_q, t_kv = q.shape[2], k.shape[2]
+    block_q = min(int(block_q), t_q)
+    block_k = min(int(block_k), t_kv)
+    if t_q % block_q or t_kv % block_k:
+        # Kernel reads fixed-size VMEM slices; ragged tails go to the
+        # (differentiable) jnp path. Pad sequences to the block size to stay
+        # on the fused kernel (SparseAttentionUtils.pad_to_block_size is the
+        # helper, mirroring the reference's %16 padding,
+        # ops/transformer/transformer.py:183-193).
+        return mha_reference(q, k, v, mask=mask, causal=causal, scale=scale)
+    return _flash_attention(q, k, v, mask, float(scale), bool(causal),
+                            block_q, block_k)

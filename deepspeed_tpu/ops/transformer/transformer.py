@@ -1,0 +1,247 @@
+"""DeepSpeedTransformerLayer — the fused BERT-style encoder layer.
+
+TPU-native re-design of the reference's fused transformer op
+(deepspeed/ops/transformer/transformer.py:39 DeepSpeedTransformerConfig,
+:153 DeepSpeedTransformerFunction, :260 DeepSpeedTransformerLayer; kernels in
+csrc/transformer/ds_transformer_cuda.cpp:624 Forward / :809 Backward).
+
+Same parameter surface (the 12 tensors: attn_qkvw/b, attn_ow/ob, attn_nw/nb,
+inter_w/b, output_w/b, norm_w/b), same config knobs, but the execution is a
+composition of Pallas kernels instead of a persistent C++ layer object:
+
+  qkv GEMM -> flash attention (fused score GEMM+softmax+ctx GEMM, replacing
+  launch_attn_softmax + cuBLAS strided-batch GEMMs) -> attn-out GEMM ->
+  fused bias+dropout+residual -> fused LN -> FF1 GEMM -> fused bias+GELU ->
+  FF2 GEMM -> fused bias+dropout+residual -> fused LN
+
+The reference's per-layer-id object registry + shared workspace singleton
+(csrc/includes/context.h:42-83) is unnecessary: XLA owns buffer reuse across
+layers. Memory-saving config flags map to remat policies:
+  normalize_invertible / gelu_checkpoint / attn_dropout_checkpoint
+  -> jax.checkpoint over the matching sub-computation.
+Sequence padding to a multiple of 16 (reference transformer.py:183-193)
+becomes padding to the flash block size, handled inside flash_attention's
+shape gate.
+"""
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer.kernels.attention import flash_attention
+from deepspeed_tpu.ops.transformer.kernels.dropout import (
+    dropout as ds_dropout, fused_bias_dropout_residual)
+from deepspeed_tpu.ops.transformer.kernels.gelu import fused_bias_gelu
+from deepspeed_tpu.ops.transformer.kernels.layer_norm import (
+    fused_bias_residual_layer_norm, fused_layer_norm)
+
+
+@dataclasses.dataclass
+class DeepSpeedTransformerConfig:
+    """Config surface of the reference DeepSpeedTransformerConfig
+    (ops/transformer/transformer.py:39-150). CUDA-specific knobs
+    (local_rank, stochastic_mode) are accepted for compatibility;
+    fp16 selects bf16 compute on TPU unless fp16 is forced."""
+
+    batch_size: int = -1
+    max_seq_length: int = -1
+    hidden_size: int = -1
+    intermediate_size: int = -1
+    heads: int = -1
+    attn_dropout_ratio: float = -1
+    hidden_dropout_ratio: float = -1
+    num_hidden_layers: int = -1
+    initializer_range: float = -1
+    layer_norm_eps: float = 1e-12
+    local_rank: int = -1
+    seed: int = -1
+    fp16: bool = False
+    pre_layer_norm: bool = True
+    normalize_invertible: bool = False
+    gelu_checkpoint: bool = False
+    adjust_init_range: bool = True
+    attn_dropout_checkpoint: bool = False
+    stochastic_mode: bool = False
+    huggingface: bool = False
+    training: bool = True
+    # TPU-only: compute dtype (bf16 is the native fast path).
+    dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.intermediate_size in (-1, None) and self.hidden_size > 0:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @classmethod
+    def from_dict(cls, json_object):
+        config = cls()
+        for key, value in json_object.items():
+            if hasattr(config, key):
+                setattr(config, key, value)
+        config.__post_init__()
+        return config
+
+    @classmethod
+    def from_json_file(cls, json_file):
+        import json
+        with open(json_file, "r", encoding="utf-8") as reader:
+            return cls.from_dict(json.loads(reader.read()))
+
+
+class DeepSpeedTransformerLayer(nn.Module):
+    """Fused transformer layer (flax). Parameter names/shapes match the
+    reference module (ops/transformer/transformer.py:269-309) so weights
+    round-trip through module_inject repacking."""
+
+    config: DeepSpeedTransformerConfig
+
+    def setup(self):
+        cfg = self.config
+        h = cfg.hidden_size
+        inter = cfg.intermediate_size
+        std = cfg.initializer_range if cfg.initializer_range > 0 else 0.02
+        out_std = std
+        if cfg.adjust_init_range and cfg.num_hidden_layers > 0:
+            # Output-projection init scaled by depth (reference
+            # transformer.py:279-284 "output_std = std / sqrt(2L)").
+            out_std = std / (2.0 * cfg.num_hidden_layers) ** 0.5
+        ini = nn.initializers.normal
+        self.attn_qkvw = self.param("attn_qkvw", ini(std), (3 * h, h), jnp.float32)
+        self.attn_qkvb = self.param("attn_qkvb", nn.initializers.zeros, (3 * h,), jnp.float32)
+        self.attn_ow = self.param("attn_ow", ini(out_std), (h, h), jnp.float32)
+        self.attn_ob = self.param("attn_ob", nn.initializers.zeros, (h,), jnp.float32)
+        self.attn_nw = self.param("attn_nw", nn.initializers.ones, (h,), jnp.float32)
+        self.attn_nb = self.param("attn_nb", nn.initializers.zeros, (h,), jnp.float32)
+        self.inter_w = self.param("inter_w", ini(std), (inter, h), jnp.float32)
+        self.inter_b = self.param("inter_b", nn.initializers.zeros, (inter,), jnp.float32)
+        self.output_w = self.param("output_w", ini(out_std), (h, inter), jnp.float32)
+        self.output_b = self.param("output_b", nn.initializers.zeros, (h,), jnp.float32)
+        self.norm_w = self.param("norm_w", nn.initializers.ones, (h,), jnp.float32)
+        self.norm_b = self.param("norm_b", nn.initializers.zeros, (h,), jnp.float32)
+
+    def _attention(self, x, attention_mask, seed, deterministic):
+        cfg = self.config
+        B, T, H = x.shape
+        nh = cfg.heads
+        hd = H // nh
+        dt = cfg.dtype
+
+        qkv = x @ self.attn_qkvw.astype(dt).T + self.attn_qkvb.astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+
+        def attn_fn(q, k, v):
+            ctx = flash_attention(q, k, v, mask=attention_mask, causal=False)
+            if cfg.attn_dropout_ratio > 0 and not deterministic:
+                # Flash never materialises probs, so attention dropout moves
+                # to the context output (same regularisation role as
+                # attn_dropout_checkpoint's recompute-in-backward).
+                ctx = ds_dropout(ctx, cfg.attn_dropout_ratio, seed)
+            return ctx
+        if cfg.attn_dropout_checkpoint:
+            attn_fn = jax.checkpoint(attn_fn)
+        ctx = attn_fn(q, k, v)
+
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, T, H)
+        return ctx @ self.attn_ow.astype(dt).T
+
+    def __call__(self, hidden_states, attention_mask=None, deterministic=None):
+        """hidden_states: [B, T, H]; attention_mask: additive [B, T] padding
+        mask (0 keep / large-negative drop), the reference's convention."""
+        cfg = self.config
+        if deterministic is None:
+            deterministic = not cfg.training
+        dt = cfg.dtype
+        x = hidden_states.astype(dt)
+        eps = cfg.layer_norm_eps
+        seed = cfg.seed if cfg.seed > 0 else 42
+        # Distinct streams per dropout site, deterministic per layer+site.
+        seeds = [seed + i for i in range(4)]
+
+        if cfg.pre_layer_norm:
+            h = fused_layer_norm(x, self.attn_nw, self.attn_nb, eps)
+            attn_out = self._attention(h, attention_mask, seeds[0],
+                                       deterministic)
+            x = fused_bias_dropout_residual(
+                attn_out, self.attn_ob, x, cfg.hidden_dropout_ratio,
+                seeds[1], deterministic)
+            h = fused_layer_norm(x, self.norm_w, self.norm_b, eps)
+        else:
+            attn_out = self._attention(x, attention_mask, seeds[0],
+                                       deterministic)
+            x = self._post_ln(attn_out, x, self.attn_ob, self.attn_nw,
+                              self.attn_nb, cfg.hidden_dropout_ratio,
+                              seeds[1], deterministic, eps)
+            h = x
+
+        def ff(h_in, res):
+            ff1 = h_in @ self.inter_w.astype(dt).T
+            act = fused_bias_gelu(ff1, self.inter_b)
+            ff2 = act @ self.output_w.astype(dt).T
+            if cfg.pre_layer_norm:
+                return fused_bias_dropout_residual(
+                    ff2, self.output_b, res, cfg.hidden_dropout_ratio,
+                    seeds[2], deterministic)
+            return self._post_ln(ff2, res, self.output_b, self.norm_w,
+                                 self.norm_b, cfg.hidden_dropout_ratio,
+                                 seeds[2], deterministic, eps)
+
+        if cfg.gelu_checkpoint:
+            ff = jax.checkpoint(ff)
+        out = ff(h, x)
+        return out
+
+    def _post_ln(self, y, residual, bias, nw, nb, rate, seed, deterministic,
+                 eps):
+        # Post-LN epilogue: LN(dropout(y + bias) + residual) — the fused
+        # bias_residual LN of normalize_kernels.cu:226.
+        if rate > 0 and not deterministic:
+            z = fused_bias_dropout_residual(y, bias, residual, rate, seed,
+                                            deterministic)
+            return fused_layer_norm(z, nw, nb, eps)
+        return fused_bias_residual_layer_norm(y, residual, nw, nb, bias=bias,
+                                              eps=eps)
+
+
+def transformer_layer_reference(params, x, attention_mask, config):
+    """Plain-jnp reference of the fused layer (parity oracle, mirroring how
+    tests/unit/test_cuda_forward.py checks the CUDA layer against vendored
+    BertLayer modeling code)."""
+    from deepspeed_tpu.ops.transformer.kernels.attention import mha_reference
+    from deepspeed_tpu.ops.transformer.kernels.gelu import bias_gelu_reference
+    from deepspeed_tpu.ops.transformer.kernels.layer_norm import (
+        layer_norm_reference)
+
+    cfg = config
+    dt = cfg.dtype
+    B, T, H = x.shape
+    nh = cfg.heads
+    hd = H // nh
+    p = {k: v.astype(dt) for k, v in params.items()}
+    x = x.astype(dt)
+    eps = cfg.layer_norm_eps
+
+    def attention(h):
+        qkv = h @ p["attn_qkvw"].T + p["attn_qkvb"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+        ctx = mha_reference(q, k, v, mask=attention_mask)
+        return ctx.transpose(0, 2, 1, 3).reshape(B, T, H) @ p["attn_ow"].T
+
+    if cfg.pre_layer_norm:
+        h = layer_norm_reference(x, p["attn_nw"], p["attn_nb"], eps)
+        x = x + attention(h) + p["attn_ob"]
+        h = layer_norm_reference(x, p["norm_w"], p["norm_b"], eps)
+        ff = bias_gelu_reference(h @ p["inter_w"].T, p["inter_b"])
+        return x + ff @ p["output_w"].T + p["output_b"]
+    x = layer_norm_reference(attention(x) + p["attn_ob"] + x,
+                             p["attn_nw"], p["attn_nb"], eps)
+    ff = bias_gelu_reference(x @ p["inter_w"].T, p["inter_b"])
+    return layer_norm_reference(ff @ p["output_w"].T + p["output_b"] + x,
+                                p["norm_w"], p["norm_b"], eps)

@@ -1,0 +1,176 @@
+"""Parity tests for the Pallas kernel tier vs pure-jnp references — the TPU
+equivalent of reference tests/unit/test_cuda_forward.py /
+test_cuda_backward.py (fused CUDA layer vs vendored BertLayer across
+batch/seq/hidden/heads grids, fwd and bwd)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.transformer.kernels.attention import (
+    flash_attention, mha_reference)
+from deepspeed_tpu.ops.transformer.kernels.dropout import (
+    dropout, fused_bias_dropout_residual)
+from deepspeed_tpu.ops.transformer.kernels.gelu import (
+    bias_gelu_reference, fused_bias_gelu)
+from deepspeed_tpu.ops.transformer.kernels.layer_norm import (
+    fused_bias_residual_layer_norm, fused_layer_norm, layer_norm_reference)
+from deepspeed_tpu.ops.transformer.kernels.softmax import (
+    attn_softmax, attn_softmax_reference)
+
+RTOL, ATOL = 1e-5, 1e-5
+
+
+def rand(rng, *shape):
+    return jnp.asarray(rng.randn(*shape), jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("use_mask", [False, True])
+@pytest.mark.parametrize("b,h,t,d", [(1, 2, 64, 32), (2, 3, 128, 16)])
+def test_flash_attention_forward(b, h, t, d, use_mask, causal):
+    rng = np.random.RandomState(7)
+    q, k, v = rand(rng, b, h, t, d), rand(rng, b, h, t, d), rand(rng, b, h, t, d)
+    mask = None
+    if use_mask:
+        mask = jnp.where(jnp.asarray(rng.rand(b, t)) > 0.25, 0.0, -1e9)
+        mask = mask.astype(jnp.float32)
+    o = flash_attention(q, k, v, mask=mask, causal=causal,
+                        block_q=32, block_k=32)
+    ref = mha_reference(q, k, v, mask=mask, causal=causal)
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_backward(causal):
+    rng = np.random.RandomState(3)
+    b, h, t, d = 2, 2, 64, 32
+    q, k, v = rand(rng, b, h, t, d), rand(rng, b, h, t, d), rand(rng, b, h, t, d)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       block_q=32, block_k=32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g, gr):
+        np.testing.assert_allclose(a, b_, rtol=1e-3, atol=1e-4)
+
+
+def test_flash_attention_ragged_fallback():
+    # Non-divisible seq lengths take the jnp path; result must still match.
+    rng = np.random.RandomState(5)
+    b, h, t, d = 1, 2, 100, 16
+    q, k, v = rand(rng, b, h, t, d), rand(rng, b, h, t, d), rand(rng, b, h, t, d)
+    o = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("shape", [(64, 256), (2, 32, 128)])
+def test_fused_layer_norm(shape):
+    rng = np.random.RandomState(11)
+    x = rand(rng, *shape)
+    gamma = rand(rng, shape[-1])
+    beta = rand(rng, shape[-1])
+    y = fused_layer_norm(x, gamma, beta)
+    ref = layer_norm_reference(x, gamma, beta)
+    np.testing.assert_allclose(y, ref, rtol=RTOL, atol=ATOL)
+
+
+def test_fused_layer_norm_grad():
+    rng = np.random.RandomState(13)
+    x, gamma, beta = rand(rng, 32, 128), rand(rng, 128), rand(rng, 128)
+
+    def f(x, g, b):
+        return jnp.sum(fused_layer_norm(x, g, b) ** 2)
+
+    def fr(x, g, b):
+        return jnp.sum(layer_norm_reference(x, g, b) ** 2)
+
+    grads = jax.grad(f, argnums=(0, 1, 2))(x, gamma, beta)
+    grads_r = jax.grad(fr, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b_ in zip(grads, grads_r):
+        np.testing.assert_allclose(a, b_, rtol=1e-3, atol=1e-4)
+
+
+def test_fused_bias_residual_layer_norm():
+    rng = np.random.RandomState(17)
+    x, res = rand(rng, 4, 16, 128), rand(rng, 4, 16, 128)
+    gamma, beta, bias = rand(rng, 128), rand(rng, 128), rand(rng, 128)
+    y = fused_bias_residual_layer_norm(x, res, gamma, beta, bias=bias)
+    ref = layer_norm_reference(x + bias + res, gamma, beta)
+    np.testing.assert_allclose(y, ref, rtol=RTOL, atol=ATOL)
+
+
+def test_fused_bias_gelu():
+    rng = np.random.RandomState(19)
+    x, bias = rand(rng, 16, 512), rand(rng, 512)
+    np.testing.assert_allclose(fused_bias_gelu(x, bias),
+                               bias_gelu_reference(x, bias),
+                               rtol=RTOL, atol=ATOL)
+    g = jax.grad(lambda x, b: jnp.sum(fused_bias_gelu(x, b) ** 2),
+                 argnums=(0, 1))(x, bias)
+    gr = jax.grad(lambda x, b: jnp.sum(bias_gelu_reference(x, b) ** 2),
+                  argnums=(0, 1))(x, bias)
+    for a, b_ in zip(g, gr):
+        np.testing.assert_allclose(a, b_, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("use_mask", [False, True])
+def test_attn_softmax(use_mask, causal):
+    rng = np.random.RandomState(23)
+    b, h, t = 2, 3, 64
+    s = rand(rng, b, h, t, t)
+    mask = None
+    if use_mask:
+        mask = jnp.where(jnp.asarray(rng.rand(b, t)) > 0.25, 0.0, -1e9)
+        mask = mask.astype(jnp.float32)
+    p = attn_softmax(s, mask, 0.125, causal)
+    ref = attn_softmax_reference(s, mask, 0.125, causal)
+    np.testing.assert_allclose(p, ref, rtol=1e-4, atol=1e-5)
+    # backward
+    g = jax.grad(lambda s: jnp.sum(attn_softmax(s, mask, 0.125, causal) ** 2))(s)
+    gr = jax.grad(lambda s: jnp.sum(
+        attn_softmax_reference(s, mask, 0.125, causal) ** 2))(s)
+    np.testing.assert_allclose(g, gr, rtol=1e-3, atol=1e-4)
+
+
+def test_dropout_deterministic_replay():
+    rng = np.random.RandomState(29)
+    x = rand(rng, 64, 128)
+    y1 = dropout(x, 0.5, seed=123)
+    y2 = dropout(x, 0.5, seed=123)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    # Different seed -> different mask.
+    y3 = dropout(x, 0.5, seed=124)
+    assert not np.array_equal(np.asarray(y1), np.asarray(y3))
+    # Mean preserved (inverted dropout).
+    assert abs(float(jnp.mean(y1)) - float(jnp.mean(x))) < 0.05
+    # Zeros exactly where dropped.
+    zeros = np.asarray(y1) == 0
+    assert 0.4 < zeros.mean() < 0.6
+
+
+def test_dropout_backward_uses_same_mask():
+    rng = np.random.RandomState(31)
+    x = rand(rng, 32, 64)
+    y, vjp = jax.vjp(lambda x: dropout(x, 0.5, seed=7), x)
+    (dx,) = vjp(jnp.ones_like(y))
+    # Gradient must be 2x where kept, 0 where dropped — the same mask.
+    kept = np.asarray(y) != 0
+    np.testing.assert_allclose(np.asarray(dx)[kept], 2.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx)[~kept], 0.0)
+
+
+def test_fused_bias_dropout_residual_eval():
+    rng = np.random.RandomState(37)
+    x, res = rand(rng, 8, 64), rand(rng, 8, 64)
+    bias = rand(rng, 64)
+    y = fused_bias_dropout_residual(x, bias, res, 0.1, 5, deterministic=True)
+    np.testing.assert_allclose(y, x + bias + res, rtol=1e-6, atol=1e-6)
