@@ -9,6 +9,7 @@ of NCCL/torch.distributed).
 """
 
 from deepspeed_tpu import ops  # noqa: F401
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing  # noqa: F401
 from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments

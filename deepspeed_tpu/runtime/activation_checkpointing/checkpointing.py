@@ -1,0 +1,290 @@
+"""Activation checkpointing — TPU-native rematerialisation.
+
+Capability parity with reference
+``deepspeed/runtime/activation_checkpointing/checkpointing.py:314-766``
+(Megatron-derived ``CheckpointFunction``), redesigned for JAX:
+
+- ``checkpoint(function, *args)`` → ``jax.checkpoint`` (remat). Under ``jit``
+  XLA re-runs the forward segment during the backward pass instead of storing
+  activations — the same FLOPs-for-HBM trade the reference makes, but chosen
+  per-op by the compiler rather than via autograd.Function bookkeeping.
+- ``partition_activations`` (reference ``:281``, each MP rank stores 1/mp of
+  every input, all-gathered back in backward) → a sharding constraint over the
+  ``model`` mesh axis on the remat boundary's saved inputs; GSPMD inserts the
+  all-gather in the backward exactly where ``get_full_inputs`` did.
+- ``cpu_checkpointing`` (``PA_TO_CPU``, reference ``:51``) → an offload remat
+  policy (``save_and_offload_only_these_names`` / dot-offload to
+  ``pinned_host`` memory space) so residuals live in host DRAM.
+- ``contiguous_memory_optimization`` / ``synchronize_checkpoint_boundary`` →
+  accepted no-ops: XLA owns allocation (no fragmentation to manage) and
+  scheduling (no streams to sync).
+- The CUDA RNG state machinery (``CudaRNGStatesTracker``, reference ``:147``,
+  ``_set_cuda_rng_state`` ``:114``) exists because torch RNG is stateful and
+  must be captured/restored so dropout replays identically in recompute. JAX
+  RNG is pure (threefry keys), so recompute is *automatically* bit-identical;
+  the tracker here keeps the reference's named-state API for Megatron-style
+  callers, implemented as explicit key streams.
+"""
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
+
+from deepspeed_tpu.utils.logging import logger
+
+# Config state (module-level, mirroring the reference's globals at
+# checkpointing.py:44-60).
+_CONFIGURED = False
+PARTITION_ACTIVATIONS = False
+CONTIGUOUS_CHECKPOINTING = False
+PA_TO_CPU = False
+SYNCHRONIZE = False
+PROFILE_TIME = False
+num_layers = None
+
+mpu = None
+
+# Name used by offload policies for values saved at checkpoint boundaries.
+_OFFLOAD_NAME = "ds_act_ckpt"
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+
+class RNGStatesTracker(object):
+    """Named PRNG-key streams (reference CudaRNGStatesTracker, :147-230).
+
+    The reference swaps the global CUDA RNG state inside ``fork()`` so ops in
+    the region draw from a named stream. JAX keys are explicit, so ``fork``
+    yields a fresh subkey from the named stream and advances it; two calls
+    with the same seed and call sequence produce identical keys — the property
+    the reference's state save/restore exists to guarantee.
+
+    State is a concrete base key plus a Python int counter per stream; the
+    yielded key is ``fold_in(base, counter)``. Nothing traced is ever stored,
+    so calling ``fork`` under ``jit`` cannot leak a tracer into the tracker
+    (the counter bump is a Python side effect, so like any Python side effect
+    it fires at trace time, not per cached execution — thread keys explicitly
+    through jitted code instead of relying on fork-inside-jit advancing).
+    """
+
+    def __init__(self):
+        self.states_ = {}   # name -> concrete base PRNG key
+        self.counters_ = {}  # name -> int draw counter
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.counters_ = {}
+        self.seeds_ = set()
+
+    def get_states(self):
+        return {n: (self.states_[n], self.counters_[n]) for n in self.states_}
+
+    def set_states(self, states):
+        self.states_ = {n: k for n, (k, _) in states.items()}
+        self.counters_ = {n: c for n, (_, c) in states.items()}
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise Exception("seed {} already exists".format(seed))
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise Exception("rng state {} already exists".format(name))
+        with jax.ensure_compile_time_eval():
+            self.states_[name] = jax.random.PRNGKey(seed)
+        self.counters_[name] = 0
+
+    @contextlib.contextmanager
+    def fork(self, name=_MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Yield a subkey from the named stream; advance the stream."""
+        if name not in self.states_:
+            raise Exception("rng state {} is not added".format(name))
+        counter = self.counters_[name]
+        self.counters_[name] = counter + 1
+        yield jax.random.fold_in(self.states_[name], counter)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+# Reference-compatible alias (the "cuda" in the name is historical).
+CudaRNGStatesTracker = RNGStatesTracker
+
+
+def get_cuda_rng_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed):
+    """Seed the default + model-parallel RNG streams.
+
+    Reference (checkpointing.py:233-266): data-parallel stream = seed,
+    model-parallel stream = seed + 2718 + model_parallel_rank so dropout
+    differs across MP ranks for partitioned activations but matches across DP.
+    """
+    mp_rank = 0 if mpu is None else mpu.get_model_parallel_rank()
+    model_parallel_seed = seed + 2718 + mp_rank
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(_MODEL_PARALLEL_RNG_TRACKER_NAME,
+                           model_parallel_seed)
+    return model_parallel_seed
+
+
+def _checkpoint_policy():
+    """Map the config flags onto a jax.checkpoint policy."""
+    if PA_TO_CPU:
+        # Residuals saved at the boundary are parked in host DRAM; XLA emits
+        # the device→host and host→device copies around the remat region.
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=[_OFFLOAD_NAME],
+            offload_src="device",
+            offload_dst="pinned_host")
+    # Plain remat: save nothing, recompute everything inside the region.
+    return jax.checkpoint_policies.nothing_saveable
+
+
+# The mesh used for partition_activations constraints; set by configure()
+# (the engine passes its mesh when an activation_checkpointing block exists).
+_mesh = None
+
+
+def _partition_constraint(x):
+    """Shard a saved activation over the model axis (partition_activations).
+
+    Applies only when configure() received a mesh with a >1 'model' axis;
+    otherwise a no-op (matches reference behavior when mp_size == 1).
+    """
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    if _mesh is None or not hasattr(x, "ndim") or x.ndim == 0:
+        return x
+    mp = _mesh.shape.get(mesh_lib.MODEL_AXIS, 1)
+    if mp <= 1:
+        return x
+    spec = mesh_lib._leaf_spec_over_axis(x, mesh_lib.MODEL_AXIS, mp)
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(_mesh, spec))
+
+
+def checkpoint(function, *args):
+    """Checkpoint a model segment (reference CheckpointFunction.apply, :314).
+
+    Must be called inside a traced computation (under ``jit``/``grad``) for
+    the remat to take effect — outside a trace it simply runs ``function``.
+    """
+    return checkpoint_wrapped(function)(*args)
+
+
+def checkpoint_wrapped(function):
+    """Return ``function`` wrapped with the configured remat policy.
+
+    The composable form (decorate layers once, call many times) — preferred
+    over ``checkpoint()`` in new JAX code.
+    """
+    inner = function
+    if PA_TO_CPU or PARTITION_ACTIVATIONS:
+        # The two compose (reference PA_TO_CPU means *partitioned* activations
+        # offloaded to host): shard over the model axis first, then tag the
+        # (sharded) value for host offload.
+        def inner(*xs, **kw):  # noqa: E306
+            def tag(a):
+                if not hasattr(a, "ndim"):
+                    return a
+                if PARTITION_ACTIVATIONS:
+                    a = _partition_constraint(a)
+                if PA_TO_CPU:
+                    a = _checkpoint_name(a, _OFFLOAD_NAME)
+                return a
+            xs = jax.tree_util.tree_map(tag, xs)
+            return function(*xs, **kw)
+    return jax.checkpoint(inner, policy=_checkpoint_policy())
+
+
+class CheckpointFunction(object):
+    """Reference-compatible shim: Megatron-style callers invoke
+    ``CheckpointFunction.apply(run_function, *args)`` (reference :314)."""
+
+    @staticmethod
+    def apply(function, *args):
+        return checkpoint(function, *args)
+
+
+def partition_activations_in_checkpoint(partition_activation):
+    global PARTITION_ACTIVATIONS
+    PARTITION_ACTIVATIONS = partition_activation
+    logger.info("**************Partition Activations {}************".format(
+        PARTITION_ACTIVATIONS))
+
+
+def set_num_layers(nlayers):
+    global num_layers
+    num_layers = nlayers
+
+
+def reset():
+    """Reference resets contiguous buffers per step; nothing to free under
+    XLA, but keep the hook so training loops can call it unconditionally."""
+
+
+def _configure_using_config_file(deepspeed_config):
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    global num_layers, PARTITION_ACTIVATIONS, CONTIGUOUS_CHECKPOINTING, \
+        PA_TO_CPU, SYNCHRONIZE, PROFILE_TIME
+
+    config = DeepSpeedConfig(deepspeed_config).activation_checkpointing_config
+    logger.info(config.repr())
+    PARTITION_ACTIVATIONS = config.partition_activations
+    CONTIGUOUS_CHECKPOINTING = config.contiguous_memory_optimization
+    num_layers = config.number_checkpoints
+    PA_TO_CPU = config.cpu_checkpointing
+    SYNCHRONIZE = config.synchronize_checkpoint_boundary
+    PROFILE_TIME = config.profile
+
+
+def configure(mpu_=None,
+              deepspeed_config=None,
+              partition_activations=None,
+              contiguous_checkpointing=None,
+              num_checkpoints=None,
+              checkpoint_in_cpu=None,
+              synchronize=None,
+              profile=None,
+              mesh_=None):
+    """Configure activation checkpointing (reference :599-673 signature).
+
+    TPU-only extra: ``mesh_`` supplies the jax Mesh whose 'model' axis
+    partition_activations shards over.
+    """
+    global mpu, num_layers, PARTITION_ACTIVATIONS, CONTIGUOUS_CHECKPOINTING, \
+        PA_TO_CPU, SYNCHRONIZE, PROFILE_TIME, _CONFIGURED, _mesh
+
+    _CONFIGURED = True
+    if mpu_ is not None:
+        mpu = mpu_
+    if mesh_ is not None:
+        _mesh = mesh_
+
+    if deepspeed_config is not None:
+        _configure_using_config_file(deepspeed_config)
+
+    if partition_activations is not None:
+        PARTITION_ACTIVATIONS = partition_activations
+    if contiguous_checkpointing is not None:
+        CONTIGUOUS_CHECKPOINTING = contiguous_checkpointing
+    if num_checkpoints is not None:
+        num_layers = num_checkpoints
+    if checkpoint_in_cpu is not None:
+        PA_TO_CPU = checkpoint_in_cpu
+    if synchronize is not None:
+        SYNCHRONIZE = synchronize
+    if profile is not None:
+        PROFILE_TIME = profile
+
+    if CONTIGUOUS_CHECKPOINTING:
+        assert num_layers is not None, \
+            "Must specify the number of checkpoints with contiguous memory optimization"
+
+
+def is_configured():
+    return _CONFIGURED

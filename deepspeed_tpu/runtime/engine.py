@@ -153,6 +153,8 @@ class DeepSpeedEngine(object):
         if self.pld_enabled():
             self.progressive_layer_drop = self._configure_progressive_layer_drop()
 
+        self._configure_checkpointing()
+
         # Jitted program caches, keyed by static call signature.
         self._fwd_bwd_cache = {}
         self._update_fn = None
@@ -413,6 +415,27 @@ class DeepSpeedEngine(object):
             else:
                 self.lr_scheduler = client_lr_scheduler
         log_dist("DeepSpeed LR Scheduler = {}".format(self.lr_scheduler), ranks=[0])
+
+    def _configure_checkpointing(self):
+        """Push an explicit activation_checkpointing config block into the
+        module-level checkpointing state. TPU-build convenience: the reference
+        leaves configure() to the user (Megatron calls it); here ds_config is
+        the single source of truth, but only when the block is present — a
+        user's earlier direct configure() call is never clobbered."""
+        from deepspeed_tpu.runtime.activation_checkpointing.config import ACT_CHKPT
+        if ACT_CHKPT not in (self._config._param_dict or {}):
+            return
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+        cfg = self._config.activation_checkpointing_config
+        checkpointing.configure(
+            mpu_=self.mpu,
+            partition_activations=cfg.partition_activations,
+            contiguous_checkpointing=cfg.contiguous_memory_optimization,
+            num_checkpoints=cfg.number_checkpoints,
+            checkpoint_in_cpu=cfg.cpu_checkpointing,
+            synchronize=cfg.synchronize_checkpoint_boundary,
+            profile=cfg.profile,
+            mesh_=self.mesh)
 
     def _configure_progressive_layer_drop(self):
         return ProgressiveLayerDrop(theta=self.pld_theta(), gamma=self.pld_gamma())
