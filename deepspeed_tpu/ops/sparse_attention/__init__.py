@@ -1,0 +1,11 @@
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    SparsityConfig, DenseSparsityConfig, FixedSparsityConfig,
+    VariableSparsityConfig, BigBirdSparsityConfig, BSLongformerSparsityConfig)
+from deepspeed_tpu.ops.sparse_attention.kernels import (
+    block_sparse_attention, block_sparse_attention_reference, build_luts)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    SparseSelfAttention, sparse_self_attention)
+from deepspeed_tpu.ops.sparse_attention.bert_sparse_self_attention import (
+    BertSparseSelfAttention)
+from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
+    SparseAttentionUtils)

@@ -1,0 +1,63 @@
+"""BertSparseSelfAttention — BERT attention block with sparse attention core
+(reference deepspeed/ops/sparse_attention/bert_sparse_self_attention.py:8-88).
+"""
+
+import dataclasses
+
+import flax.linen as nn
+
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    SparseSelfAttention)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    FixedSparsityConfig, SparsityConfig)
+
+
+@dataclasses.dataclass
+class BertConfigLike:
+    """Minimal duck-typed stand-in for a HF/Bert config object."""
+    hidden_size: int
+    num_attention_heads: int
+
+
+class BertSparseSelfAttention(nn.Module):
+    """Q/K/V projections + SparseSelfAttention + head merge, the sparse twin
+    of a BertSelfAttention layer.
+
+    `config` needs `.hidden_size` and `.num_attention_heads` (same duck
+    typing as the reference, bert_sparse_self_attention.py:36-44).
+    """
+
+    config: object = None
+    sparsity_config: SparsityConfig = None
+
+    def setup(self):
+        cfg = self.config
+        if cfg.hidden_size % cfg.num_attention_heads != 0:
+            raise ValueError(
+                "The hidden size (%d) is not a multiple of the number of "
+                "attention heads (%d)" % (cfg.hidden_size,
+                                          cfg.num_attention_heads))
+        self.num_attention_heads = cfg.num_attention_heads
+        self.attention_head_size = cfg.hidden_size // cfg.num_attention_heads
+        self.all_head_size = (self.num_attention_heads *
+                              self.attention_head_size)
+        self.query = nn.Dense(self.all_head_size, name='query')
+        self.key = nn.Dense(self.all_head_size, name='key')
+        self.value = nn.Dense(self.all_head_size, name='value')
+        sc = (self.sparsity_config if self.sparsity_config is not None
+              else FixedSparsityConfig(num_heads=cfg.num_attention_heads))
+        self.sparse_self_attention = SparseSelfAttention(sparsity_config=sc)
+
+    def _transpose_for_scores(self, x):
+        b, t, _ = x.shape
+        x = x.reshape(b, t, self.num_attention_heads, self.attention_head_size)
+        return x.transpose(0, 2, 1, 3)
+
+    def __call__(self, hidden_states, attention_mask=None):
+        q = self._transpose_for_scores(self.query(hidden_states))
+        k = self._transpose_for_scores(self.key(hidden_states))
+        v = self._transpose_for_scores(self.value(hidden_states))
+        ctx = self.sparse_self_attention(q, k, v,
+                                         key_padding_mask=attention_mask)
+        b, h, t, d = ctx.shape
+        return ctx.transpose(0, 2, 1, 3).reshape(b, t, self.all_head_size)

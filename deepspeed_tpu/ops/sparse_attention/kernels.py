@@ -1,0 +1,430 @@
+"""Block-sparse flash attention — the TPU-native replacement for the
+reference's Triton kernel trio (sdd matmul -> sparse softmax -> dsd matmul,
+reference deepspeed/ops/sparse_attention/matmul.py:16-60, softmax.py:17-40)
+and its OpenMP `sdd_segment` load balancer (csrc/sparse_attention/utils.cpp:119).
+
+Design: the SparsityConfig layout [H, nb, nb] is compile-time metadata. It is
+lowered (host-side, numpy) to a per-(head, query-block) lookup table of active
+key-block indices, padded to the max row degree. One Pallas kernel then runs a
+flash-style online-softmax sweep over *only the active blocks*: scores for a
+block pair live in VMEM registers and the [T, T] matrix is never materialized.
+This fuses the reference's three kernel launches (plus its block
+gather/scatter) into a single MXU-resident kernel, and replaces the sdd_segment
+load-balancing machinery entirely — the grid is naturally balanced because
+every (head, q-block) program does max_degree iterations with inactive slots
+masked (layouts produced by SparsityConfig have near-uniform row degree).
+
+Backward follows the two-pass flash scheme: a dq kernel walks the same LUT; a
+dk/dv kernel walks the *transposed* LUT (for each key block, the query blocks
+that touch it), both recomputing probabilities from the saved logsumexp.
+
+All kernels run in interpret mode off-TPU so the CPU test mesh exercises the
+identical code path.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def build_luts(layout):
+    """Lower a [H, nb, nb] 0/1 layout to forward and transposed LUTs.
+
+    Returns (fwd_lut [H, nb, max_deg], bwd_lut [H, nb, max_deg_t]) int32
+    numpy arrays padded with -1. fwd_lut[h, i] lists the active key blocks for
+    query block i; bwd_lut[h, j] lists the active query blocks for key block j.
+    """
+    layout = np.asarray(layout, dtype=bool)
+    h, nb, _ = layout.shape
+
+    def rows_to_lut(mat):  # mat: [H, rows, cols] bool
+        deg = mat.sum(-1).max() if mat.any() else 1
+        deg = max(int(deg), 1)
+        lut = np.full((h, mat.shape[1], deg), -1, dtype=np.int32)
+        for hi in range(h):
+            for r in range(mat.shape[1]):
+                cols = np.nonzero(mat[hi, r])[0]
+                lut[hi, r, :len(cols)] = cols
+        return lut
+
+    return rows_to_lut(layout), rows_to_lut(layout.transpose(0, 2, 1))
+
+
+def _apply_masks(s, q_start, c, blk, kpm_blk, bias_blk, valid, causal,
+                 kpm_mode, bias_mode):
+    """Score post-processing shared by all kernels. s: [bq, blk] fp32."""
+    if kpm_blk is not None:
+        s = s * kpm_blk if kpm_mode == 'mul' else s + kpm_blk
+    if bias_blk is not None:
+        s = s * bias_blk if bias_mode == 'mul' else s + bias_blk
+    if causal:
+        bq = s.shape[0]
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, blk), 0)
+        k_pos = c * blk + jax.lax.broadcasted_iota(jnp.int32, (bq, blk), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return jnp.where(valid, s, NEG_INF)
+
+
+def _unpack(refs, n_out, has_kpm, has_bias):
+    """Split the flat pallas ref list into (q, k, v, lut, kpm, bias, rest...)."""
+    refs = list(refs)
+    q_ref, k_ref, v_ref, lut_ref = refs[:4]
+    idx = 4
+    kpm_ref = bias_ref = None
+    if has_kpm:
+        kpm_ref = refs[idx]
+        idx += 1
+    if has_bias:
+        bias_ref = refs[idx]
+        idx += 1
+    return q_ref, k_ref, v_ref, lut_ref, kpm_ref, bias_ref, refs[idx:]
+
+
+def _fwd_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
+                bias_mode):
+    (q_ref, k_ref, v_ref, lut_ref, kpm_ref, bias_ref,
+     (o_ref, lse_ref)) = _unpack(refs, 2, has_kpm, has_bias)
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale            # [bq, d]
+    bq, d = q.shape
+    iq = pl.program_id(2)
+    max_deg = lut_ref.shape[2]
+
+    def body(j, carry):
+        acc, m_prev, l_prev = carry
+        col = lut_ref[0, 0, j]
+        valid = col >= 0
+        c = jnp.maximum(col, 0)
+        k_blk = k_ref[0, 0, pl.ds(c * blk, blk)].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(c * blk, blk)].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kpm_blk = (kpm_ref[0, pl.ds(c * blk, blk)][None, :]
+                   if kpm_ref is not None else None)
+        bias_blk = (bias_ref[0, 0, :, pl.ds(c * blk, blk)]
+                    if bias_ref is not None else None)
+        s = _apply_masks(s, iq * bq, c, blk, kpm_blk, bias_blk, valid, causal,
+                         kpm_mode, bias_mode)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # Keep m finite when a whole block is masked (exp(-inf - -inf) traps).
+        m_safe = jnp.maximum(m_new, 0.5 * NEG_INF)
+        alpha = jnp.exp(m_prev - m_safe)
+        p = jnp.exp(s - m_safe)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(
+        0, max_deg, body,
+        (jnp.zeros((bq, d), jnp.float32),
+         jnp.full((bq, 1), NEG_INF, jnp.float32),
+         jnp.zeros((bq, 1), jnp.float32)))
+
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.maximum(m, 0.5 * NEG_INF) + jnp.log(l)
+
+
+def _bwd_dq_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
+                   bias_mode):
+    (q_ref, k_ref, v_ref, lut_ref, kpm_ref, bias_ref,
+     (do_ref, lse_ref, delta_ref, dq_ref)) = _unpack(refs, 1, has_kpm, has_bias)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    bq, d = q.shape
+    iq = pl.program_id(2)
+
+    def body(j, dq):
+        col = lut_ref[0, 0, j]
+        valid = col >= 0
+        c = jnp.maximum(col, 0)
+        k_blk = k_ref[0, 0, pl.ds(c * blk, blk)].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(c * blk, blk)].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        kpm_blk = (kpm_ref[0, pl.ds(c * blk, blk)][None, :]
+                   if kpm_ref is not None else None)
+        bias_blk = (bias_ref[0, 0, :, pl.ds(c * blk, blk)]
+                    if bias_ref is not None else None)
+        s = _apply_masks(s, iq * bq, c, blk, kpm_blk, bias_blk, valid, causal,
+                         kpm_mode, bias_mode)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        # In mul-mask modes the mask scales the pre-softmax score, so it also
+        # scales the score gradient flowing back to q/k.
+        if kpm_blk is not None and kpm_mode == 'mul':
+            ds = ds * kpm_blk
+        if bias_blk is not None and bias_mode == 'mul':
+            ds = ds * bias_blk
+        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, lut_ref.shape[2], body,
+                           jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(*refs, scale, blk, bq, causal, has_kpm, has_bias, kpm_mode,
+                    bias_mode):
+    (q_ref, k_ref, v_ref, tlut_ref, kpm_ref, bias_ref,
+     (do_ref, lse_ref, delta_ref, dk_ref, dv_ref)) = _unpack(
+         refs, 2, has_kpm, has_bias)
+
+    k_blk = k_ref[0, 0].astype(jnp.float32)                # [blk, d]
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    d = k_blk.shape[1]
+    jk = pl.program_id(2)
+    kpm_blk = kpm_ref[0][None, :] if kpm_ref is not None else None  # [1, blk]
+
+    def body(j, carry):
+        dk, dv = carry
+        row = tlut_ref[0, 0, j]
+        valid = row >= 0
+        r = jnp.maximum(row, 0)
+        q = q_ref[0, 0, pl.ds(r * bq, bq)].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(r * bq, bq)].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(r * bq, bq)]
+        delta = delta_ref[0, 0, pl.ds(r * bq, bq)]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        bias_blk = (bias_ref[0, 0, pl.ds(r * bq, bq), :]
+                    if bias_ref is not None else None)
+        s = _apply_masks(s, r * bq, jk, blk, kpm_blk, bias_blk, valid, causal,
+                         kpm_mode, bias_mode)
+        p = jnp.exp(s - lse)                               # [bq, blk]
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        if kpm_blk is not None and kpm_mode == 'mul':
+            ds = ds * kpm_blk
+        if bias_blk is not None and bias_mode == 'mul':
+            ds = ds * bias_blk
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        0, tlut_ref.shape[2], body,
+        (jnp.zeros((blk, d), jnp.float32), jnp.zeros((blk, d), jnp.float32)))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp assembly — one cached closure per (layout, flags) so the LUTs are
+# baked into the jaxpr as constants (the layout is per-layer static metadata).
+# ---------------------------------------------------------------------------
+
+_FN_CACHE = {}
+
+
+def _make_fn(fwd_lut, bwd_lut, blk, scale, causal, has_kpm, has_bias,
+             kpm_mode, bias_mode):
+    # LUTs stay numpy in the closure; they are converted per call so that a
+    # closure first built under a jit trace never caches tracer constants.
+    fwd_lut = np.asarray(fwd_lut)
+    bwd_lut = np.asarray(bwd_lut)
+    flags = dict(causal=causal, has_kpm=has_kpm, has_bias=has_bias,
+                 kpm_mode=kpm_mode, bias_mode=bias_mode)
+
+    def fwd(q, k, v, kpm, bias):
+        b, h, t, d = q.shape
+        lut = jnp.asarray(fwd_lut)
+        nq = t // blk
+        grid = (b, h, nq)
+        q_spec = pl.BlockSpec((1, 1, blk, d), lambda b_, h_, i: (b_, h_, i, 0))
+        full = pl.BlockSpec((1, 1, t, d), lambda b_, h_, i: (b_, h_, 0, 0))
+        lut_spec = pl.BlockSpec((1, 1, fwd_lut.shape[2]),
+                                lambda b_, h_, i: (h_, i, 0))
+        in_specs = [q_spec, full, full, lut_spec]
+        args = [q, k, v, lut]
+        if has_kpm:
+            in_specs.append(pl.BlockSpec((1, t), lambda b_, h_, i: (b_, 0)))
+            args.append(kpm.astype(jnp.float32))
+        if has_bias:
+            in_specs.append(pl.BlockSpec((1, 1, blk, t),
+                                         lambda b_, h_, i: (b_, h_, i, 0)))
+            args.append(bias.astype(jnp.float32))
+        o, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel, scale=scale, blk=blk, **flags),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=[q_spec,
+                       pl.BlockSpec((1, 1, blk, 1),
+                                    lambda b_, h_, i: (b_, h_, i, 0))],
+            out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                       jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32)],
+            interpret=_interpret(),
+        )(*args)
+        return o, lse
+
+    @jax.custom_vjp
+    def attend(q, k, v, kpm, bias):
+        return fwd(q, k, v, kpm, bias)[0]
+
+    def attend_fwd(q, k, v, kpm, bias):
+        o, lse = fwd(q, k, v, kpm, bias)
+        return o, (q, k, v, kpm, bias, o, lse)
+
+    def attend_bwd(res, g):
+        q, k, v, kpm, bias, o, lse = res
+        b, h, t, d = q.shape
+        lut = jnp.asarray(fwd_lut)
+        tlut = jnp.asarray(bwd_lut)
+        do = g
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        q_spec = pl.BlockSpec((1, 1, blk, d), lambda b_, h_, i: (b_, h_, i, 0))
+        full = pl.BlockSpec((1, 1, t, d), lambda b_, h_, i: (b_, h_, 0, 0))
+        row_blk = pl.BlockSpec((1, 1, blk, 1), lambda b_, h_, i: (b_, h_, i, 0))
+        row_full = pl.BlockSpec((1, 1, t, 1), lambda b_, h_, i: (b_, h_, 0, 0))
+        lut_spec = pl.BlockSpec((1, 1, fwd_lut.shape[2]),
+                                lambda b_, h_, i: (h_, i, 0))
+
+        in_specs = [q_spec, full, full, lut_spec]
+        args = [q, k, v, lut]
+        if has_kpm:
+            in_specs.append(pl.BlockSpec((1, t), lambda b_, h_, i: (b_, 0)))
+            args.append(kpm.astype(jnp.float32))
+        if has_bias:
+            in_specs.append(pl.BlockSpec((1, 1, blk, t),
+                                         lambda b_, h_, i: (b_, h_, i, 0)))
+            args.append(bias.astype(jnp.float32))
+        in_specs += [q_spec, row_blk, row_blk]
+        args += [do, lse, delta]
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, scale=scale, blk=blk, **flags),
+            grid=(b, h, t // blk),
+            in_specs=in_specs,
+            out_specs=q_spec,
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=_interpret(),
+        )(*args)
+
+        kv_spec = pl.BlockSpec((1, 1, blk, d), lambda b_, h_, j: (b_, h_, j, 0))
+        tlut_spec = pl.BlockSpec((1, 1, bwd_lut.shape[2]),
+                                 lambda b_, h_, j: (h_, j, 0))
+        in_specs = [full, kv_spec, kv_spec, tlut_spec]
+        args = [q, k, v, tlut]
+        if has_kpm:
+            in_specs.append(pl.BlockSpec((1, blk), lambda b_, h_, j: (b_, j)))
+            args.append(kpm.astype(jnp.float32))
+        if has_bias:
+            in_specs.append(pl.BlockSpec((1, 1, t, blk),
+                                         lambda b_, h_, j: (b_, h_, 0, j)))
+            args.append(bias.astype(jnp.float32))
+        in_specs += [full, row_full, row_full]
+        args += [do, lse, delta]
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, scale=scale, blk=blk, bq=blk,
+                              **flags),
+            grid=(b, h, t // blk),
+            in_specs=in_specs,
+            out_specs=[kv_spec, kv_spec],
+            out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                       jax.ShapeDtypeStruct(v.shape, v.dtype)],
+            interpret=_interpret(),
+        )(*args)
+
+        dkpm = None if kpm is None else jnp.zeros_like(kpm)
+        dbias = None if bias is None else jnp.zeros_like(bias)
+        return dq, dk, dv, dkpm, dbias
+
+    attend.defvjp(attend_fwd, attend_bwd)
+    return attend
+
+
+def block_sparse_attention(q, k, v, layout, block, scale=None, causal=False,
+                           key_padding_mask=None, key_padding_mask_mode='add',
+                           attn_bias=None, attn_bias_mode='add'):
+    """Block-sparse multi-head attention steered by a SparsityConfig layout.
+
+    Args:
+      q, k, v: [B, H, T, D]; T must be a multiple of `block`
+        (SparseAttentionUtils.pad_to_block_size pads).
+      layout: [H, T//block, T//block] 0/1 numpy array from
+        SparsityConfig.make_layout.
+      block: layout block size.
+      causal: additionally apply an elementwise causal mask (the layouts from
+        unidirectional configs are causal only at block granularity; this
+        sharpens the diagonal blocks).
+      key_padding_mask: [B, T] mask combined per mask mode ('add': added to
+        scores; 'mul': multiplies scores — the reference softmax's semantics,
+        softmax.py:17-40).
+      attn_bias: [B, H, T, T] additive/multiplicative score bias — carries the
+        reference's `rpe` and 2D `attn_mask` arguments.
+    Returns: [B, H, T, D] in q.dtype.
+    """
+    b, h, t, d = q.shape
+    if t % block != 0:
+        raise ValueError('Sequence Length, {}, needs to be dividable by '
+                         'Block size {}!'.format(t, block))
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    layout = np.asarray(layout)
+    if layout.shape[0] != h:
+        raise ValueError('layout heads {} != tensor heads {}'.format(
+            layout.shape[0], h))
+    key = (layout.tobytes(), layout.shape, int(block), float(scale),
+           bool(causal), key_padding_mask is not None,
+           attn_bias is not None, key_padding_mask_mode, attn_bias_mode)
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        fwd_lut, bwd_lut = build_luts(layout)
+        fn = _make_fn(fwd_lut, bwd_lut, int(block), float(scale),
+                      bool(causal), key_padding_mask is not None,
+                      attn_bias is not None, key_padding_mask_mode,
+                      attn_bias_mode)
+        _FN_CACHE[key] = fn
+    return fn(q, k, v, key_padding_mask, attn_bias)
+
+
+def block_sparse_attention_reference(q, k, v, layout, block, scale=None,
+                                     causal=False, key_padding_mask=None,
+                                     key_padding_mask_mode='add',
+                                     attn_bias=None, attn_bias_mode='add'):
+    """Dense jnp ground truth: expand the block layout to an elementwise mask
+    and run ordinary softmax attention. Used by parity tests."""
+    b, h, t, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    layout = np.asarray(layout)
+    dense = np.kron(layout, np.ones((block, block)))[:, :t, :t]  # [H, T, T]
+    s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if key_padding_mask is not None:
+        kpm = key_padding_mask.astype(jnp.float32)[:, None, None, :]
+        s = s * kpm if key_padding_mask_mode == 'mul' else s + kpm
+    if attn_bias is not None:
+        ab = attn_bias.astype(jnp.float32)
+        s = s * ab if attn_bias_mode == 'mul' else s + ab
+    if causal:
+        cm = jnp.tril(jnp.ones((t, t), dtype=bool))
+        s = jnp.where(cm[None, None], s, NEG_INF)
+    s = jnp.where(jnp.asarray(dense, dtype=bool)[None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # Fully-masked rows (no active blocks) produce zeros, matching the kernel.
+    row_any = jnp.asarray(dense.any(-1), dtype=bool)[None, :, :, None]
+    if causal:
+        pass
+    p = jnp.where(row_any, p, 0.0)
+    return jnp.einsum('bhqk,bhkd->bhqd', p, v.astype(jnp.float32)).astype(q.dtype)

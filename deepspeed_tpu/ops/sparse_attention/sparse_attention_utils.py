@@ -1,0 +1,107 @@
+"""Helpers for running sparse attention inside existing models — padding
+sequences to block multiples and swapping attention layers
+(reference deepspeed/ops/sparse_attention/sparse_attention_utils.py:14-225).
+"""
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention.bert_sparse_self_attention import (
+    BertSparseSelfAttention)
+
+
+class SparseAttentionUtils:
+    """Static helpers mirroring the reference class surface."""
+
+    @staticmethod
+    def extend_position_embedding(params, max_position):
+        """Tile a learned position-embedding table out to `max_position` rows
+        (reference :19-66 does this in-place on HF modules; here it maps over
+        a param tree and returns the updated copy).
+
+        `params` may be the embedding array itself or a dict containing an
+        'embedding' entry (flax nn.Embed param layout).
+        """
+        def extend(table):
+            orig = table.shape[0]
+            if max_position <= orig:
+                return table[:max_position]
+            reps = -(-max_position // orig)
+            return jnp.tile(table, (reps, 1))[:max_position]
+
+        if isinstance(params, dict):
+            out = dict(params)
+            out['embedding'] = extend(jnp.asarray(params['embedding']))
+            return out
+        return extend(jnp.asarray(params))
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position):
+        """Sync a HF tokenizer's model_max_length with the extended position
+        embedding (reference :68-83)."""
+        tokenizer.model_max_length = max_position
+        if hasattr(tokenizer, 'init_kwargs'):
+            tokenizer.init_kwargs['model_max_length'] = max_position
+        return tokenizer
+
+    @staticmethod
+    def replace_model_self_attention_with_sparse_self_attention(
+            model, max_position, sparsity_config=None):
+        """Reference :85-121 mutates HF torch modules in place; the flax
+        equivalent is module_inject-style tree surgery. See
+        deepspeed_tpu.module_inject.replace_attn_with_sparse for the
+        implementation; this wrapper exists for API parity."""
+        from deepspeed_tpu.module_inject import replace_attn_with_sparse
+        return replace_attn_with_sparse(model, max_position, sparsity_config)
+
+    @staticmethod
+    def replace_self_attention_layer_with_sparse_self_attention_layer(
+            config, layers, sparsity_config=None):
+        """Build BertSparseSelfAttention replacements for each given layer
+        (reference :123-149)."""
+        return [BertSparseSelfAttention(config=config,
+                                        sparsity_config=sparsity_config)
+                for _ in layers]
+
+    @staticmethod
+    def pad_to_block_size(block_size, input_ids, attention_mask,
+                          token_type_ids, position_ids, inputs_embeds,
+                          pad_token_id, model_embeddings):
+        """Pad token/mask/embedding inputs along sequence length to a multiple
+        of `block_size` (reference :151-208). Returns
+        (pad_len, input_ids, attention_mask, token_type_ids, position_ids,
+        inputs_embeds), each padded or passed through as None.
+        """
+        if input_ids is not None:
+            seq_len = input_ids.shape[1]
+        else:
+            seq_len = inputs_embeds.shape[-2]
+        pad_len = (block_size - seq_len % block_size) % block_size
+        if pad_len > 0:
+            pad2 = ((0, 0), (0, pad_len))
+            if inputs_embeds is not None:
+                pad_ids = jnp.full((inputs_embeds.shape[0], pad_len),
+                                   pad_token_id, dtype=jnp.int32)
+                pad_embeds = model_embeddings(pad_ids)
+                inputs_embeds = jnp.concatenate([inputs_embeds, pad_embeds],
+                                                axis=-2)
+            if input_ids is not None:
+                input_ids = jnp.pad(input_ids, pad2,
+                                    constant_values=pad_token_id)
+            if position_ids is not None:
+                position_ids = jnp.pad(position_ids, pad2,
+                                       constant_values=pad_token_id)
+            if attention_mask is not None:
+                attention_mask = jnp.pad(attention_mask, pad2,
+                                         constant_values=0)
+            if token_type_ids is not None:
+                token_type_ids = jnp.pad(token_type_ids, pad2,
+                                         constant_values=0)
+        return (pad_len, input_ids, attention_mask, token_type_ids,
+                position_ids, inputs_embeds)
+
+    @staticmethod
+    def unpad_sequence_output(pad_len, sequence_output):
+        """Strip the padding added by pad_to_block_size (reference :210-224)."""
+        if pad_len > 0:
+            sequence_output = sequence_output[:, :-pad_len]
+        return sequence_output

@@ -1,0 +1,115 @@
+"""SparseSelfAttention — layout-driven sparse attention orchestrator.
+
+API mirror of the reference module (deepspeed/ops/sparse_attention/
+sparse_self_attention.py:14-160): takes [B, H, T, D] q/k/v plus optional rpe /
+key_padding_mask / attn_mask with 'add'/'mul' combine modes, steered by a
+SparsityConfig.
+
+TPU-native differences:
+- the reference builds three Triton ops (sdd matmul, sparse softmax, dsd
+  matmul) per sequence length and broadcasts the layout across ranks; here the
+  layout is host-side trace metadata compiled into ONE fused Pallas kernel
+  (kernels.block_sparse_attention), and there is nothing to synchronize —
+  every process traces the same deterministic layout.
+- masked (inactive) attention rows produce zeros instead of NaNs.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention.kernels import block_sparse_attention
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import SparsityConfig
+
+
+def sparse_self_attention(query, key, value, sparsity_config, rpe=None,
+                          key_padding_mask=None, attn_mask=None,
+                          key_padding_mask_mode='add', attn_mask_mode='mul',
+                          causal=None):
+    """Functional sparse self attention.
+
+    Arguments follow the reference forward (sparse_self_attention.py:105-160):
+      query/key/value: [B, H, T, D] (self-attention: identical shapes).
+      rpe: optional relative-position score bias, [T, T], [H, T, T] or
+        [B, H, T, T].
+      key_padding_mask: optional [B, T], combined per key_padding_mask_mode.
+      attn_mask: optional [T, T], combined per attn_mask_mode.
+      causal: elementwise causal masking; default on iff the sparsity config
+        is unidirectional.
+    """
+    if query.shape != key.shape or key.shape != value.shape:
+        raise NotImplementedError('only self-attention is supported for now')
+    b, h, t, d = query.shape
+    layout = _layout_for(sparsity_config, t)
+    if causal is None:
+        causal = getattr(sparsity_config, 'attention', None) == 'unidirectional'
+
+    bias = None
+    bias_mode = 'add'
+    if rpe is not None:
+        bias = _broadcast_bias(jnp.asarray(rpe), b, h, t)
+    if attn_mask is not None:
+        am = jnp.asarray(attn_mask)
+        if am.ndim != 2:
+            raise NotImplementedError('currently only 2D attn_mask is supported')
+        am = _broadcast_bias(am, b, h, t)
+        if bias is None:
+            bias = am
+            bias_mode = attn_mask_mode
+        else:
+            # rpe is additive; fold a mul-mask in by shifting masked scores
+            # far negative instead (same post-softmax result: zero weight).
+            if attn_mask_mode == 'mul':
+                bias = jnp.where(am != 0, bias, -1e30)
+            else:
+                bias = bias + am
+
+    return block_sparse_attention(
+        query, key, value, layout, sparsity_config.block,
+        scale=float(d) ** -0.5, causal=causal,
+        key_padding_mask=key_padding_mask,
+        key_padding_mask_mode=key_padding_mask_mode,
+        attn_bias=bias, attn_bias_mode=bias_mode)
+
+
+_LAYOUT_CACHE = {}
+
+
+def _layout_for(config, seq_len):
+    key = (id(config), seq_len)
+    layout = _LAYOUT_CACHE.get(key)
+    if layout is None:
+        layout = config.make_layout(seq_len)
+        _LAYOUT_CACHE[key] = layout
+    return layout
+
+
+def _broadcast_bias(x, b, h, t):
+    if x.ndim == 2:
+        x = x[None, None]
+    elif x.ndim == 3:
+        x = x[None]
+    return jnp.broadcast_to(x, (b, h, t, t))
+
+
+class SparseSelfAttention(nn.Module):
+    """Module wrapper matching the reference class surface
+    (sparse_self_attention.py:14-47)."""
+
+    sparsity_config: SparsityConfig = None
+    key_padding_mask_mode: str = 'add'
+    attn_mask_mode: str = 'mul'
+    max_seq_length: int = 2048  # accepted for API parity; layouts are built
+                                # lazily per actual sequence length.
+
+    def _config(self):
+        return (self.sparsity_config if self.sparsity_config is not None
+                else SparsityConfig(num_heads=4))
+
+    @nn.compact
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
+                 attn_mask=None):
+        return sparse_self_attention(
+            query, key, value, self._config(), rpe=rpe,
+            key_padding_mask=key_padding_mask, attn_mask=attn_mask,
+            key_padding_mask_mode=self.key_padding_mask_mode,
+            attn_mask_mode=self.attn_mask_mode)

@@ -72,19 +72,25 @@ class RNGStatesTracker(object):
     def __init__(self):
         self.states_ = {}   # name -> concrete base PRNG key
         self.counters_ = {}  # name -> int draw counter
+        self.name_seeds_ = {}  # name -> int seed (for state round-trips)
         self.seeds_ = set()
 
     def reset(self):
         self.states_ = {}
         self.counters_ = {}
+        self.name_seeds_ = {}
         self.seeds_ = set()
 
     def get_states(self):
-        return {n: (self.states_[n], self.counters_[n]) for n in self.states_}
+        return {n: (self.states_[n], self.counters_[n],
+                    self.name_seeds_.get(n)) for n in self.states_}
 
     def set_states(self, states):
-        self.states_ = {n: k for n, (k, _) in states.items()}
-        self.counters_ = {n: c for n, (_, c) in states.items()}
+        self.states_ = {n: s[0] for n, s in states.items()}
+        self.counters_ = {n: s[1] for n, s in states.items()}
+        self.name_seeds_ = {n: s[2] for n, s in states.items()
+                            if len(s) > 2 and s[2] is not None}
+        self.seeds_ = set(self.name_seeds_.values())
 
     def add(self, name, seed):
         if seed in self.seeds_:
@@ -95,6 +101,7 @@ class RNGStatesTracker(object):
         with jax.ensure_compile_time_eval():
             self.states_[name] = jax.random.PRNGKey(seed)
         self.counters_[name] = 0
+        self.name_seeds_[name] = seed
 
     @contextlib.contextmanager
     def fork(self, name=_MODEL_PARALLEL_RNG_TRACKER_NAME):
@@ -180,25 +187,31 @@ def checkpoint_wrapped(function):
     """Return ``function`` wrapped with the configured remat policy.
 
     The composable form (decorate layers once, call many times) — preferred
-    over ``checkpoint()`` in new JAX code.
+    over ``checkpoint()`` in new JAX code. Config flags are read at *call*
+    (trace) time, not wrap time, so layers decorated at model construction
+    pick up a later ``configure()`` / engine config (the reference reads its
+    globals per-apply the same way).
     """
-    inner = function
-    if PA_TO_CPU or PARTITION_ACTIVATIONS:
-        # The two compose (reference PA_TO_CPU means *partitioned* activations
-        # offloaded to host): shard over the model axis first, then tag the
-        # (sharded) value for host offload.
-        def inner(*xs, **kw):  # noqa: E306
-            def tag(a):
-                if not hasattr(a, "ndim"):
+    def wrapped(*args, **kwargs):
+        inner = function
+        if PA_TO_CPU or PARTITION_ACTIVATIONS:
+            # The two compose (reference PA_TO_CPU means *partitioned*
+            # activations offloaded to host): shard over the model axis first,
+            # then tag the (sharded) value for host offload.
+            def inner(*xs, **kw):  # noqa: E306
+                def tag(a):
+                    if not hasattr(a, "ndim"):
+                        return a
+                    if PARTITION_ACTIVATIONS:
+                        a = _partition_constraint(a)
+                    if PA_TO_CPU:
+                        a = _checkpoint_name(a, _OFFLOAD_NAME)
                     return a
-                if PARTITION_ACTIVATIONS:
-                    a = _partition_constraint(a)
-                if PA_TO_CPU:
-                    a = _checkpoint_name(a, _OFFLOAD_NAME)
-                return a
-            xs = jax.tree_util.tree_map(tag, xs)
-            return function(*xs, **kw)
-    return jax.checkpoint(inner, policy=_checkpoint_policy())
+                xs, kw = jax.tree_util.tree_map(tag, (xs, kw))
+                return function(*xs, **kw)
+        return jax.checkpoint(inner, policy=_checkpoint_policy())(*args,
+                                                                  **kwargs)
+    return wrapped
 
 
 class CheckpointFunction(object):

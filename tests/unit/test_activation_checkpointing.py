@@ -14,13 +14,15 @@ from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
 @pytest.fixture(autouse=True)
 def _reset_config():
     yield
-    # configure() mutates module globals; restore defaults between tests.
-    checkpointing.configure(partition_activations=False,
-                            contiguous_checkpointing=False,
-                            num_checkpoints=1,
-                            checkpoint_in_cpu=False,
-                            synchronize=False,
-                            profile=False)
+    # configure() mutates module globals; restore import defaults between
+    # tests (including _CONFIGURED, so is_configured() assertions stay real).
+    checkpointing.PARTITION_ACTIVATIONS = False
+    checkpointing.CONTIGUOUS_CHECKPOINTING = False
+    checkpointing.PA_TO_CPU = False
+    checkpointing.SYNCHRONIZE = False
+    checkpointing.PROFILE_TIME = False
+    checkpointing.num_layers = None
+    checkpointing._CONFIGURED = False
     checkpointing._mesh = None
     checkpointing.mpu = None
 

@@ -1,0 +1,277 @@
+"""Sparse attention tests — parity of the Pallas block-sparse kernel against
+a dense jnp reference, over the five SparsityConfig patterns (mirrors
+reference tests/unit/test_sparse_attention.py, which checks the Triton ops
+against dense torch matmul/softmax).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BertSparseSelfAttention, BigBirdSparsityConfig, BSLongformerSparsityConfig,
+    DenseSparsityConfig, FixedSparsityConfig, SparseAttentionUtils,
+    SparseSelfAttention, SparsityConfig, VariableSparsityConfig,
+    block_sparse_attention, block_sparse_attention_reference, build_luts,
+    sparse_self_attention)
+from deepspeed_tpu.ops.sparse_attention.bert_sparse_self_attention import (
+    BertConfigLike)
+
+
+def make_qkv(b=2, h=4, t=64, d=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, h, t, d)
+    return tuple(jax.random.normal(k, shape, dtype=jnp.float32) for k in ks)
+
+
+# ---------------------------------------------------------------------------
+# Layout construction
+# ---------------------------------------------------------------------------
+
+def test_dense_layout_all_ones():
+    layout = DenseSparsityConfig(num_heads=2, block=16).make_layout(64)
+    assert layout.shape == (2, 4, 4)
+    assert layout.all()
+
+
+def test_fixed_layout_local_and_global():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                              num_global_blocks=1)
+    layout = cfg.make_layout(128)  # 8 blocks
+    # local: dense 2-block windows on the diagonal
+    assert layout[0, 0, 0] and layout[0, 0, 1] and layout[0, 1, 0]
+    assert not layout[0, 0, 2]
+    # global: last block of each window is a global column for all rows below
+    assert layout[0, 7, 1] and layout[0, 7, 3] and layout[0, 7, 5]
+    # heads identical when different_layout_per_head=False
+    assert (layout[0] == layout[1]).all()
+
+
+def test_fixed_layout_unidirectional_is_block_lower_triangular():
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                              attention='unidirectional')
+    layout = cfg.make_layout(128)
+    nb = layout.shape[1]
+    for i in range(nb):
+        for j in range(nb):
+            if j > i:
+                assert not layout[0, i, j]
+
+
+def test_fixed_layout_validation_errors():
+    with pytest.raises(ValueError):
+        FixedSparsityConfig(num_heads=1, num_local_blocks=3, num_global_blocks=2)
+    with pytest.raises(ValueError):
+        FixedSparsityConfig(num_heads=1, attention='unidirectional',
+                            horizontal_global_attention=True)
+    with pytest.raises(NotImplementedError):
+        FixedSparsityConfig(num_heads=1, attention='bydirectional')
+    with pytest.raises(ValueError):
+        # different global patterns require different_layout_per_head
+        FixedSparsityConfig(num_heads=2, num_different_global_patterns=2)
+
+
+def test_bigbird_layout():
+    cfg = BigBirdSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=1, seed=0)
+    layout = cfg.make_layout(128)
+    nb = layout.shape[1]
+    # global row/col stripes
+    assert layout[0, 0, :].all() and layout[0, :, 0].all()
+    # sliding window
+    for i in range(nb):
+        for j in range(max(0, i - 1), min(nb, i + 2)):
+            assert layout[0, i, j]
+    # each row has >= 1 random block beyond structure (just check density)
+    assert layout[0].sum() >= 3 * nb - 2
+
+
+def test_bslongformer_layout_with_end_indices():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0],
+                                     global_block_end_indices=[2])
+    layout = cfg.make_layout(128)
+    assert layout[0, :2, :].all() and layout[0, :, :2].all()
+
+
+def test_variable_layout():
+    cfg = VariableSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                 local_window_blocks=[1, 2],
+                                 global_block_indices=[0], seed=3)
+    layout = cfg.make_layout(128)
+    # global column 0 attended by all rows
+    assert layout[0, :, 0].all()
+    # local windows: block 0 alone, blocks 1-2 together, then repeated size 2
+    assert layout[0, 1, 1] and layout[0, 1, 2] and layout[0, 2, 1]
+
+
+def test_seq_len_not_divisible_raises():
+    with pytest.raises(ValueError):
+        DenseSparsityConfig(num_heads=1, block=16).make_layout(100)
+
+
+def test_build_luts():
+    layout = np.zeros((1, 3, 3), dtype=np.int64)
+    layout[0, 0, 0] = 1
+    layout[0, 1, [0, 2]] = 1
+    layout[0, 2, 2] = 1
+    fwd, bwd = build_luts(layout)
+    assert fwd.shape == (1, 3, 2)
+    assert list(fwd[0, 1]) == [0, 2]
+    assert fwd[0, 0, 0] == 0 and fwd[0, 0, 1] == -1
+    # transpose: block col 0 touched by rows 0,1; col 2 by rows 1,2
+    assert list(bwd[0, 0]) == [0, 1]
+    assert list(bwd[0, 2]) == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# Kernel parity vs dense reference
+# ---------------------------------------------------------------------------
+
+CONFIGS = [
+    ('dense', DenseSparsityConfig(num_heads=4, block=16)),
+    ('fixed', FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2,
+                                  num_global_blocks=1)),
+    ('fixed_uni', FixedSparsityConfig(num_heads=4, block=16,
+                                      num_local_blocks=2,
+                                      attention='unidirectional')),
+    ('bigbird', BigBirdSparsityConfig(num_heads=4, block=16,
+                                      num_random_blocks=1,
+                                      num_sliding_window_blocks=3,
+                                      num_global_blocks=1, seed=1)),
+    ('bslongformer', BSLongformerSparsityConfig(num_heads=4, block=16,
+                                                num_sliding_window_blocks=3)),
+    ('variable', VariableSparsityConfig(num_heads=4, block=16,
+                                        num_random_blocks=1,
+                                        local_window_blocks=[2],
+                                        global_block_indices=[0], seed=2)),
+]
+
+
+@pytest.mark.parametrize('name,cfg', CONFIGS, ids=[c[0] for c in CONFIGS])
+def test_kernel_forward_parity(name, cfg):
+    q, k, v = make_qkv(t=64)
+    layout = cfg.make_layout(64)
+    causal = getattr(cfg, 'attention', None) == 'unidirectional'
+    out = block_sparse_attention(q, k, v, layout, cfg.block, causal=causal)
+    ref = block_sparse_attention_reference(q, k, v, layout, cfg.block,
+                                           causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize('name,cfg', CONFIGS[:3], ids=[c[0] for c in CONFIGS[:3]])
+def test_kernel_grad_parity(name, cfg):
+    q, k, v = make_qkv(b=1, h=4, t=64)
+    layout = cfg.make_layout(64)
+    causal = getattr(cfg, 'attention', None) == 'unidirectional'
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(block_sparse_attention(q, k, v, layout, cfg.block,
+                                              causal=causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(block_sparse_attention_reference(
+            q, k, v, layout, cfg.block, causal=causal) ** 2)
+
+    g = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_kernel_key_padding_mask_add():
+    q, k, v = make_qkv(t=64)
+    layout = FixedSparsityConfig(num_heads=4, block=16,
+                                 num_local_blocks=2).make_layout(64)
+    kpm = jnp.where(jnp.arange(64) < 48, 0.0, -1e9)[None, :].repeat(2, 0)
+    out = block_sparse_attention(q, k, v, layout, 16, key_padding_mask=kpm)
+    ref = block_sparse_attention_reference(q, k, v, layout, 16,
+                                           key_padding_mask=kpm)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_kernel_attn_bias_rpe():
+    q, k, v = make_qkv(b=1, t=32)
+    layout = DenseSparsityConfig(num_heads=4, block=16).make_layout(32)
+    rpe = jax.random.normal(jax.random.PRNGKey(9), (1, 4, 32, 32)) * 0.1
+    out = block_sparse_attention(q, k, v, layout, 16, attn_bias=rpe)
+    ref = block_sparse_attention_reference(q, k, v, layout, 16, attn_bias=rpe)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_kernel_jit_and_cache():
+    q, k, v = make_qkv(t=32)
+    layout = DenseSparsityConfig(num_heads=4, block=16).make_layout(32)
+
+    @jax.jit
+    def f(q, k, v):
+        return block_sparse_attention(q, k, v, layout, 16)
+
+    out = f(q, k, v)
+    out2 = f(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2))
+
+
+# ---------------------------------------------------------------------------
+# Orchestrators
+# ---------------------------------------------------------------------------
+
+def test_sparse_self_attention_functional():
+    q, k, v = make_qkv(t=64)
+    cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2)
+    out = sparse_self_attention(q, k, v, cfg)
+    assert out.shape == q.shape
+    layout = cfg.make_layout(64)
+    ref = block_sparse_attention_reference(q, k, v, layout, 16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_self_attention_module():
+    q, k, v = make_qkv(t=32)
+    mod = SparseSelfAttention(
+        sparsity_config=DenseSparsityConfig(num_heads=4, block=16))
+    out = mod.apply({}, q, k, v)
+    assert out.shape == q.shape
+
+
+def test_bert_sparse_self_attention():
+    cfg = BertConfigLike(hidden_size=64, num_attention_heads=4)
+    mod = BertSparseSelfAttention(
+        config=cfg,
+        sparsity_config=FixedSparsityConfig(num_heads=4, block=16,
+                                            num_local_blocks=2))
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 64))
+    params = mod.init(jax.random.PRNGKey(1), x)
+    out = mod.apply(params, x)
+    assert out.shape == (2, 64, 64)
+
+
+def test_pad_unpad_to_block_size():
+    ids = jnp.ones((2, 60), dtype=jnp.int32)
+    mask = jnp.ones((2, 60), dtype=jnp.int32)
+    (pad_len, ids2, mask2, tt, pos,
+     emb) = SparseAttentionUtils.pad_to_block_size(
+         16, ids, mask, None, None, None, 0, None)
+    assert pad_len == 4
+    assert ids2.shape == (2, 64) and mask2.shape == (2, 64)
+    assert int(mask2[0, -1]) == 0
+    seq = jnp.ones((2, 64, 8))
+    out = SparseAttentionUtils.unpad_sequence_output(pad_len, seq)
+    assert out.shape == (2, 60, 8)
+
+
+def test_extend_position_embedding():
+    table = jnp.arange(512 * 4, dtype=jnp.float32).reshape(512, 4)
+    out = SparseAttentionUtils.extend_position_embedding(
+        {'embedding': table}, 1024)
+    assert out['embedding'].shape == (1024, 4)
+    np.testing.assert_allclose(np.asarray(out['embedding'][512:]),
+                               np.asarray(table))
