@@ -96,6 +96,12 @@ class DeepSpeedEngine(object):
         self.micro_steps = 0
         self.skipped_steps = 0
         self.gradient_average = True
+        # API-parity flag (reference engine.py:369-372 reads it to skip the
+        # dense allreduce). On the TPU jit path gradient reduction is a GSPMD
+        # sharding decision made at trace time, so this flag is informational:
+        # OnebitAdam flips it at the freeze boundary so user scripts that
+        # consult it (as with the reference) observe the same transition.
+        self.enable_backward_allreduce = True
         self.warn_unscaled_loss = True
         self.progressive_layer_drop = None
         self.dist_backend = "xla-ici"
@@ -450,6 +456,12 @@ class DeepSpeedEngine(object):
                 "exp_avg": opt_fn(self.opt_state["exp_avg"]),
                 "exp_avg_sq": opt_fn(self.opt_state["exp_avg_sq"]),
             }
+            # Extra optimizer state (e.g. OnebitAdam error-feedback buffers)
+            # follows the same ZeRO policy as the moments — error buffers are
+            # elementwise state and must not stay replicated under ZeRO.
+            for key in self.opt_state:
+                if key not in moment_sh:
+                    moment_sh[key] = opt_fn(self.opt_state[key])
             self.opt_state_sharding = moment_sh
             # Place state according to policy now (one-time reshard).
             self.opt_state = jax.device_put(self.opt_state, moment_sh)
@@ -725,6 +737,12 @@ class DeepSpeedEngine(object):
 
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
+        if hasattr(self.optimizer, "notify_step"):
+            # OnebitAdam freeze bookkeeping (reference onebit_adam.py:369-372).
+            # Keyed off applied updates (the jitted state['step']), not
+            # global_steps, so fp16 overflow-skipped steps don't desync the
+            # host flag from the compiled phase switch.
+            self.optimizer.notify_step(self.global_steps - self.skipped_steps)
 
     def step(self, lr_kwargs=None):
         """Weight update at gradient-accumulation boundaries
@@ -836,6 +854,8 @@ class DeepSpeedEngine(object):
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
         self.micro_steps += 1
+        if hasattr(self.optimizer, "notify_step"):
+            self.optimizer.notify_step(self.global_steps - self.skipped_steps)
         self.tput_timer.stop(True)
         return loss
 
