@@ -1,0 +1,41 @@
+// Tensor flatten/unflatten for host-side bucketing — the `utils` op
+// (reference csrc/utils/flatten_unflatten.cpp:11-25, apex-derived; loaded by
+// the engine and ZeRO for gradient bucketing). On TPU the device-side
+// equivalent is XLA fusion; this host version serves the ZeRO-Offload tier,
+// where master params/grads are packed into one contiguous buffer so a
+// single OpenMP Adam pass covers every tensor.
+
+#include <cstring>
+
+extern "C" {
+
+// Concatenate `count` spans into dst. sizes[i] = element count of srcs[i].
+void ds_flatten(const float* const* srcs,
+                const long* sizes,
+                int count,
+                float* __restrict__ dst) {
+    // Prefix offsets (serial: count is small, copies dominate).
+    long offset = 0;
+#pragma omp parallel for schedule(dynamic)
+    for (int i = 0; i < count; ++i) {
+        long off = 0;
+        for (int j = 0; j < i; ++j) off += sizes[j];
+        std::memcpy(dst + off, srcs[i], (size_t)sizes[i] * sizeof(float));
+    }
+    (void)offset;
+}
+
+// Scatter a flat buffer back into `count` spans.
+void ds_unflatten(float* const* dsts,
+                  const long* sizes,
+                  int count,
+                  const float* __restrict__ src) {
+#pragma omp parallel for schedule(dynamic)
+    for (int i = 0; i < count; ++i) {
+        long off = 0;
+        for (int j = 0; j < i; ++j) off += sizes[j];
+        std::memcpy(dsts[i], src + off, (size_t)sizes[i] * sizeof(float));
+    }
+}
+
+}  // extern "C"

@@ -365,6 +365,11 @@ class DeepSpeedEngine(object):
         if client_optimizer is not None:
             self.optimizer = client_optimizer
             log_dist("Using client Optimizer as basic optimizer", ranks=[0])
+            if self.zero_cpu_offload() and not self._offload_mode():
+                logger.warning(
+                    "zero_optimization.cpu_offload is set but the client "
+                    "optimizer is not DeepSpeedCPUAdam — optimizer state "
+                    "stays in HBM (no offload).")
         elif self._config.optimizer_name is not None:
             self.optimizer = self._configure_basic_optimizer(model_parameters)
             log_dist("Using DeepSpeed Optimizer param name {} as basic optimizer"
@@ -374,8 +379,13 @@ class DeepSpeedEngine(object):
             return
 
         self.opt_state = None
-        if self.params is not None:
+        self._offload = None  # host-state bookkeeping (ZeRO-Offload tier)
+        if self.params is not None and not self._offload_mode():
             self.opt_state = self.optimizer.init_state(self.params)
+
+    def _offload_mode(self):
+        from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+        return isinstance(self.optimizer, DeepSpeedCPUAdam)
 
     def _configure_basic_optimizer(self, model_parameters):
         """Optimizer factory table (reference engine.py:577-617)."""
@@ -386,12 +396,30 @@ class DeepSpeedEngine(object):
         if name in [ADAM_OPTIMIZER, ADAMW_OPTIMIZER]:
             adam_w_mode = (name == ADAMW_OPTIMIZER) or \
                 (self.optimizer_params() or {}).get("adam_w_mode", name == ADAMW_OPTIMIZER)
+            if self.zero_cpu_offload():
+                # ZeRO-Offload decision matrix (reference engine.py:577-617):
+                # cpu_offload selects DeepSpeedCPUAdam; optimizer state lives
+                # in host DRAM and the update runs in the C++ op.
+                from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+                return DeepSpeedCPUAdam(model_params=model_parameters,
+                                        adamw_mode=adam_w_mode,
+                                        **optimizer_parameters)
             return FusedAdam(params=model_parameters,
                              adam_w_mode=adam_w_mode,
                              **optimizer_parameters)
         elif name == LAMB_OPTIMIZER:
+            if self.zero_cpu_offload():
+                raise ValueError(
+                    "zero_optimization.cpu_offload requires an Adam/AdamW "
+                    "optimizer (got {}); the host tier is DeepSpeedCPUAdam"
+                    .format(name))
             return FusedLamb(params=model_parameters, **optimizer_parameters)
         elif name == ONEBIT_ADAM_OPTIMIZER:
+            if self.zero_cpu_offload():
+                raise ValueError(
+                    "zero_optimization.cpu_offload requires an Adam/AdamW "
+                    "optimizer (got {}); the host tier is DeepSpeedCPUAdam"
+                    .format(name))
             from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdam
             return OnebitAdam(params=model_parameters, deepspeed=self,
                               **optimizer_parameters)
@@ -450,7 +478,7 @@ class DeepSpeedEngine(object):
         stage = self.zero_optimization_stage() if self.zero_optimization() else 0
         self.param_sharding, self.grad_sharding, opt_fn = \
             mesh_lib.zero_shardings(self.mesh, self.params, stage)
-        if self.opt_state is not None:
+        if self.opt_state is not None and not self._offload_mode():
             moment_sh = {
                 "step": mesh_lib.replicated(self.mesh),
                 "exp_avg": opt_fn(self.opt_state["exp_avg"]),
@@ -590,7 +618,7 @@ class DeepSpeedEngine(object):
                 {"params": self._next_rng(), "dropout": self._next_rng()},
                 *inputs, **init_kwargs)
             self.params = variables["params"]
-            if self.optimizer is not None:
+            if self.optimizer is not None and not self._offload_mode():
                 self.opt_state = self.optimizer.init_state(self.params)
             self._setup_shardings()
 
@@ -721,12 +749,15 @@ class DeepSpeedEngine(object):
         else:
             group = self.optimizer.param_groups[0]
             beta1, beta2 = group.get("betas", (0.9, 0.999))
-            update_fn = self._get_update_fn()
-            self.params, self.opt_state = update_fn(
-                self.params, self.opt_state, grads,
-                jnp.float32(1.0 / cur_scale),
-                jnp.float32(group["lr"]),
-                jnp.float32(beta1), jnp.float32(beta2))
+            if self._offload_mode():
+                self._offload_step(grads, 1.0 / cur_scale, group["lr"])
+            else:
+                update_fn = self._get_update_fn()
+                self.params, self.opt_state = update_fn(
+                    self.params, self.opt_state, grads,
+                    jnp.float32(1.0 / cur_scale),
+                    jnp.float32(group["lr"]),
+                    jnp.float32(beta1), jnp.float32(beta2))
 
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step(**(lr_kwargs or {}))
@@ -743,6 +774,90 @@ class DeepSpeedEngine(object):
             # global_steps, so fp16 overflow-skipped steps don't desync the
             # host flag from the compiled phase switch.
             self.optimizer.notify_step(self.global_steps - self.skipped_steps)
+
+    # ------------------------------------------------------- ZeRO-Offload tier
+
+    def _init_offload(self):
+        """Build the host-resident fp32 master + optimizer state.
+
+        The reference keeps fp32 master partitions + Adam moments in pinned
+        CPU memory and updates them with the AVX cpu_adam op
+        (stage2.py:156,326-342, cpu_adam.cpp). Here: one contiguous fp32
+        buffer per role (master/m/v) on the host; opt_state exposes per-leaf
+        numpy *views* into those buffers so checkpoint save/load works
+        unchanged; the C++ op updates the whole flat buffer in one
+        OpenMP pass (no per-tensor launches — the multi_tensor_apply idea,
+        done by layout instead of kernel machinery).
+        """
+        leaves, treedef = jax.tree_util.tree_flatten(self.params)
+        shapes = [l.shape for l in leaves]
+        sizes = [int(np.prod(s)) if len(s) else 1 for s in shapes]
+        offsets = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+        total = int(offsets[-1])
+        master = np.empty(total, np.float32)
+        for leaf, off, size in zip(leaves, offsets[:-1], sizes):
+            master[off:off + size] = np.asarray(
+                jax.device_get(leaf), dtype=np.float32).ravel()
+        m = np.zeros(total, np.float32)
+        v = np.zeros(total, np.float32)
+
+        def views(buf):
+            return jax.tree_util.tree_unflatten(treedef, [
+                buf[off:off + size].reshape(shape) for off, size, shape in
+                zip(offsets[:-1], sizes, shapes)])
+
+        self._offload = {
+            "treedef": treedef, "shapes": shapes, "sizes": sizes,
+            "offsets": offsets, "total": total,
+            "master": master, "m": m, "v": v, "step": 0,
+        }
+        self.opt_state = {
+            "step": np.int32(0),
+            "exp_avg": views(m),
+            "exp_avg_sq": views(v),
+        }
+
+    def _offload_step(self, grads, inv_scale, lr):
+        """Host-side optimizer step (the reference's cpu-offload methods
+        block, stage2.py:740-940 + DeepSpeedCPUAdam.step)."""
+        if self._offload is None:
+            self._init_offload()
+        off = self._offload
+        opt = self.optimizer
+
+        host_g = np.empty(off["total"], np.float32)
+        g_leaves = off["treedef"].flatten_up_to(grads)
+        for leaf, o, size in zip(g_leaves, off["offsets"][:-1], off["sizes"]):
+            host_g[o:o + size] = np.asarray(
+                jax.device_get(leaf), dtype=np.float32).ravel()
+
+        if inv_scale != 1.0:
+            opt.scale_(host_g, inv_scale)
+        clip = self.gradient_clipping()
+        if clip > 0.0:
+            gnorm = opt.l2_norm(host_g)
+            if gnorm > clip:
+                opt.scale_(host_g, clip / (gnorm + 1e-6))
+
+        off["step"] += 1
+        opt.step_flat(off["master"], host_g, off["m"], off["v"],
+                      step=off["step"], lr=lr)
+        self.opt_state["step"] = np.int32(off["step"])
+
+        # Re-materialize device params from the updated host master.
+        shard_leaves = off["treedef"].flatten_up_to(self.param_sharding) \
+            if self._shardings_ready else [None] * len(off["sizes"])
+        param_leaves = off["treedef"].flatten_up_to(self.params)
+        new_leaves = []
+        for old, o, size, shape, sh in zip(param_leaves, off["offsets"][:-1],
+                                           off["sizes"], off["shapes"],
+                                           shard_leaves):
+            host = off["master"][o:o + size].reshape(shape)
+            arr = jnp.asarray(host, dtype=old.dtype)
+            if sh is not None:
+                arr = jax.device_put(arr, sh)
+            new_leaves.append(arr)
+        self.params = jax.tree_util.tree_unflatten(off["treedef"], new_leaves)
 
     def step(self, lr_kwargs=None):
         """Weight update at gradient-accumulation boundaries
@@ -792,7 +907,8 @@ class DeepSpeedEngine(object):
         if batch is None:
             assert data_iter is not None
             batch = next(data_iter)
-        if self.fp16_enabled() or self.gradient_accumulation_steps() > 1:
+        if self.fp16_enabled() or self.gradient_accumulation_steps() > 1 or \
+                self._offload_mode():
             loss = self.forward(*batch) if isinstance(batch, (tuple, list)) \
                 else self.forward(batch)
             self.backward(loss)
@@ -1001,9 +1117,12 @@ class DeepSpeedEngine(object):
             checkpoint = pickle.load(f)
 
         self.params = jax.tree_util.tree_map(jnp.asarray, checkpoint["module"])
-        if self.optimizer is not None and self.opt_state is None:
+        if self.optimizer is not None and self.opt_state is None and \
+                not self._offload_mode():
             self.opt_state = self.optimizer.init_state(self.params)
         self._setup_shardings()
+        if self._offload_mode():
+            self._init_offload()
 
         if load_optimizer_states:
             opt_sd = None
@@ -1015,10 +1134,24 @@ class DeepSpeedEngine(object):
             else:
                 opt_sd = checkpoint.get("optimizer")
             if opt_sd is not None and opt_sd.get("state") is not None:
-                self.opt_state = jax.tree_util.tree_map(
-                    jnp.asarray, opt_sd["state"])
-                self.opt_state = jax.device_put(self.opt_state,
-                                                self.opt_state_sharding)
+                if self._offload_mode():
+                    # Copy saved moments into the host buffers (views).
+                    saved = opt_sd["state"]
+                    off = self._offload
+                    for buf, key in ((off["m"], "exp_avg"),
+                                     (off["v"], "exp_avg_sq")):
+                        leaves = off["treedef"].flatten_up_to(saved[key])
+                        for leaf, o, size in zip(leaves, off["offsets"][:-1],
+                                                 off["sizes"]):
+                            buf[o:o + size] = np.asarray(leaf,
+                                                         np.float32).ravel()
+                    off["step"] = int(saved["step"])
+                    self.opt_state["step"] = np.int32(off["step"])
+                else:
+                    self.opt_state = jax.tree_util.tree_map(
+                        jnp.asarray, opt_sd["state"])
+                    self.opt_state = jax.device_put(self.opt_state,
+                                                    self.opt_state_sharding)
                 if hasattr(self.optimizer, "load_state_dict"):
                     self.optimizer.load_state_dict(opt_sd)
 

@@ -56,18 +56,26 @@ def init_onebit_adam_state(params, world_size=1):
 
 def onebit_adam_update(params, grads, state, lr, beta1=0.9, beta2=0.999,
                        eps=1e-8, weight_decay=0.0, freeze_step=100000,
-                       axis_name=None, world_size=1):
+                       axis_name=None, world_size=1, frozen=None):
     """One 1-bit Adam step over a pytree. Pure and jit-safe.
 
     If ``axis_name`` is given (shard_map path with per-worker local grads),
     the frozen phase exchanges momentum via the full two-phase
-    compressed_allreduce; otherwise grads are assumed pre-averaged and the
-    quantization runs locally (identical across workers).
+    compressed_allreduce, and the phase must be chosen *statically* via the
+    ``frozen`` bool (a collective inside a lax.cond branch gives the two
+    branches different varying-axis types and fails to trace; re-tracing once
+    at the freeze boundary is the jax idiom). Without ``axis_name``, grads
+    are assumed pre-averaged, the quantization runs locally, and the phase
+    switches under ``lax.cond`` on the traced step — one compiled program.
 
     No bias correction, mirroring the reference step (onebit_adam.py:319-355
     applies raw ``exp_avg / (sqrt(exp_avg_sq) + eps)``).
     """
     step = state["step"] + 1
+    if axis_name is not None and frozen is None:
+        raise ValueError(
+            "onebit_adam_update(axis_name=...) needs a static `frozen` flag: "
+            "the compressed collective cannot live inside lax.cond")
 
     def leaf_update(p, g, m, v, werr, serr):
         g = g.astype(jnp.float32)
@@ -79,7 +87,7 @@ def onebit_adam_update(params, grads, state, lr, beta1=0.9, beta2=0.999,
             v_new = beta2 * v + (1.0 - beta2) * jnp.square(g)
             return m_new, v_new, werr, serr
 
-        def frozen(_):
+        def frozen_branch(_):
             m_loc = beta1 * m + (1.0 - beta1) * g
             flat = jnp.zeros(werr.shape, jnp.float32).at[:n].set(
                 m_loc.reshape(-1))
@@ -92,8 +100,12 @@ def onebit_adam_update(params, grads, state, lr, beta1=0.9, beta2=0.999,
             m_new = avg[:n].reshape(p.shape)
             return m_new, v, werr_new, serr_new
 
-        m_new, v_new, werr_new, serr_new = jax.lax.cond(
-            step <= freeze_step, warmup, frozen, operand=None)
+        if axis_name is not None:
+            m_new, v_new, werr_new, serr_new = (
+                frozen_branch(None) if frozen else warmup(None))
+        else:
+            m_new, v_new, werr_new, serr_new = jax.lax.cond(
+                step <= freeze_step, warmup, frozen_branch, operand=None)
 
         update = m_new / (jnp.sqrt(v_new) + eps)
         if weight_decay > 0.0:
@@ -181,7 +193,9 @@ class OnebitAdam(object):
             eps=group["eps"], weight_decay=group["weight_decay"],
             freeze_step=self.freeze_step,
             axis_name=self.axis_name,
-            world_size=self.world_size)
+            world_size=self.world_size,
+            frozen=self.adam_freeze_key if self.axis_name is not None
+            else None)
         return new_params, new_state
 
     def notify_step(self, global_step):
