@@ -64,6 +64,11 @@ void ds_adam_step(long step,
 static inline uint16_t float_to_bf16(float f) {
     uint32_t bits;
     std::memcpy(&bits, &f, sizeof(bits));
+    // NaN first: the rounding add below can carry a low-mantissa NaN payload
+    // out of the mantissa, yielding +/-Inf instead of NaN.
+    if ((bits & 0x7fffffffu) > 0x7f800000u) {
+        return (uint16_t)((bits >> 16) | 0x0040u);  // quiet NaN, keep sign
+    }
     uint32_t lsb = (bits >> 16) & 1u;
     bits += 0x7fffu + lsb;
     return (uint16_t)(bits >> 16);

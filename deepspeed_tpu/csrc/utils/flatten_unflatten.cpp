@@ -14,15 +14,12 @@ void ds_flatten(const float* const* srcs,
                 const long* sizes,
                 int count,
                 float* __restrict__ dst) {
-    // Prefix offsets (serial: count is small, copies dominate).
-    long offset = 0;
 #pragma omp parallel for schedule(dynamic)
     for (int i = 0; i < count; ++i) {
         long off = 0;
         for (int j = 0; j < i; ++j) off += sizes[j];
         std::memcpy(dst + off, srcs[i], (size_t)sizes[i] * sizeof(float));
     }
-    (void)offset;
 }
 
 // Scatter a flat buffer back into `count` spans.

@@ -1,0 +1,3 @@
+from deepspeed_tpu.module_inject.replace_module import (
+    pack_bert_layer, replace_attn_with_sparse, replace_module,
+    replace_transformer_layer, revert_transformer_layer, unpack_bert_layer)

@@ -1,0 +1,172 @@
+"""Module injection — swap stock transformer layers for the fused
+DeepSpeedTransformerLayer (reference deepspeed/module_inject/
+replace_module.py:6-192: recursive child swap on torch modules with QKV
+weight re-packing, and the reverse).
+
+Flax models are immutable module definitions + parameter pytrees, so the
+TPU-native formulation is *param-tree surgery*: identify each HF-BERT-style
+layer subtree in the params, re-pack its weights into the fused layer's
+layout (QKV concatenated, [out, in] orientation), and apply the fused layer
+with the re-packed tree. ``revert_transformer_layer`` inverts the packing
+bit-exactly.
+"""
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                           DeepSpeedTransformerLayer)
+
+
+def _is_hf_bert_layer(tree):
+    return (isinstance(tree, dict) and
+            {"attention", "intermediate", "output"} <= set(tree.keys()))
+
+
+def _is_ds_layer(tree):
+    return (isinstance(tree, dict) and
+            {"attn_qkvw", "inter_w", "norm_w"} <= set(tree.keys()))
+
+
+def pack_bert_layer(layer):
+    """HF flax BertLayer param subtree → DeepSpeedTransformerLayer params.
+
+    The QKV concat mirrors the reference's weight re-packing
+    (replace_module.py:23-57: qkvw = cat(q.w, k.w, v.w)). Flax Dense kernels
+    are [in, out]; the fused layer stores [out, in] (y = x @ W.T).
+    """
+    att = layer["attention"]
+    sa, ao = att["self"], att["output"]
+
+    def wT(p):
+        return jnp.transpose(p["kernel"])
+
+    return {
+        "attn_qkvw": jnp.concatenate(
+            [wT(sa["query"]), wT(sa["key"]), wT(sa["value"])], axis=0),
+        "attn_qkvb": jnp.concatenate(
+            [sa["query"]["bias"], sa["key"]["bias"], sa["value"]["bias"]]),
+        "attn_ow": wT(ao["dense"]),
+        "attn_ob": ao["dense"]["bias"],
+        "attn_nw": ao["LayerNorm"]["scale"],
+        "attn_nb": ao["LayerNorm"]["bias"],
+        "inter_w": wT(layer["intermediate"]["dense"]),
+        "inter_b": layer["intermediate"]["dense"]["bias"],
+        "output_w": wT(layer["output"]["dense"]),
+        "output_b": layer["output"]["dense"]["bias"],
+        "norm_w": layer["output"]["LayerNorm"]["scale"],
+        "norm_b": layer["output"]["LayerNorm"]["bias"],
+    }
+
+
+def unpack_bert_layer(ds):
+    """Inverse of :func:`pack_bert_layer` (reference revert_transformer_layer,
+    replace_module.py:92-157)."""
+    h = ds["attn_ow"].shape[0]
+    qw, kw, vw = jnp.split(ds["attn_qkvw"], 3, axis=0)
+    qb, kb, vb = jnp.split(ds["attn_qkvb"], 3)
+
+    def dense(w_out_in, b):
+        return {"kernel": jnp.transpose(w_out_in), "bias": b}
+
+    return {
+        "attention": {
+            "self": {
+                "query": dense(qw, qb),
+                "key": dense(kw, kb),
+                "value": dense(vw, vb),
+            },
+            "output": {
+                "dense": dense(ds["attn_ow"], ds["attn_ob"]),
+                "LayerNorm": {"scale": ds["attn_nw"], "bias": ds["attn_nb"]},
+            },
+        },
+        "intermediate": {"dense": dense(ds["inter_w"], ds["inter_b"])},
+        "output": {
+            "dense": dense(ds["output_w"], ds["output_b"]),
+            "LayerNorm": {"scale": ds["norm_w"], "bias": ds["norm_b"]},
+        },
+    }
+
+
+def replace_module(params, predicate, transform):
+    """Generic recursive subtree swap (reference replace_module,
+    replace_module.py:160-192): wherever ``predicate(subtree)`` holds,
+    substitute ``transform(subtree)``; recurse elsewhere."""
+    if predicate(params):
+        return transform(params)
+    if isinstance(params, dict):
+        return {k: replace_module(v, predicate, transform)
+                for k, v in params.items()}
+    return params
+
+
+def replace_transformer_layer(orig_layer_impl=None, model=None, params=None,
+                              micro_batch_size=-1, bert_config=None,
+                              seed=-1, max_seq_length=512, preln=False,
+                              fp16=True, training=True):
+    """Re-pack every HF-BERT layer subtree in ``params`` into fused-layer
+    layout and return (fused_layer_module, new_params)
+    (reference replace_transformer_layer, replace_module.py:6-89).
+
+    ``bert_config`` needs hidden_size / num_attention_heads /
+    intermediate_size / hidden_dropout_prob / attention_probs_dropout_prob
+    (HF duck typing, as the reference).
+    """
+    if params is None:
+        raise ValueError("params pytree is required (flax models carry "
+                         "weights outside the module)")
+    cfg = DeepSpeedTransformerConfig(
+        batch_size=micro_batch_size,
+        hidden_size=bert_config.hidden_size,
+        intermediate_size=getattr(bert_config, "intermediate_size",
+                                  4 * bert_config.hidden_size),
+        heads=bert_config.num_attention_heads,
+        attn_dropout_ratio=getattr(bert_config,
+                                   "attention_probs_dropout_prob", 0.1),
+        hidden_dropout_ratio=getattr(bert_config, "hidden_dropout_prob", 0.1),
+        num_hidden_layers=getattr(bert_config, "num_hidden_layers", -1),
+        seed=seed,
+        fp16=fp16,
+        pre_layer_norm=preln,
+        training=training,
+        dtype=jnp.float16 if fp16 else jnp.float32,
+    )
+    layer = DeepSpeedTransformerLayer(config=cfg)
+    new_params = replace_module(params, _is_hf_bert_layer, pack_bert_layer)
+    return layer, new_params
+
+
+def revert_transformer_layer(orig_layer_impl=None, model=None, params=None,
+                             config=None, preln=False):
+    """Inverse swap: fused-layer subtrees → HF layout
+    (reference replace_module.py:92-157)."""
+    if params is None:
+        raise ValueError("params pytree is required")
+    return replace_module(params, _is_ds_layer, unpack_bert_layer)
+
+
+def replace_attn_with_sparse(model, max_position, sparsity_config=None):
+    """Swap a model's attention module class for BertSparseSelfAttention
+    (SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention,
+    reference sparse_attention_utils.py:85-121).
+
+    Flax modules are frozen dataclasses, so the model must expose the
+    attention implementation as a dataclass field (duck-typed:
+    ``attention_module`` or ``attention_cls``); the swap is a
+    ``dataclasses.replace``. Models that hard-code their attention raise with
+    guidance, since there is no generic child-module mutation in flax.
+    """
+    import dataclasses
+    from deepspeed_tpu.ops.sparse_attention import (BertSparseSelfAttention,
+                                                    FixedSparsityConfig)
+    for field in ("attention_module", "attention_cls"):
+        if hasattr(model, field):
+            sc = sparsity_config or FixedSparsityConfig(
+                num_heads=getattr(model, "num_attention_heads", 4))
+            return dataclasses.replace(model, **{
+                field: lambda cfg: BertSparseSelfAttention(
+                    config=cfg, sparsity_config=sc)})
+    raise TypeError(
+        "model {} does not expose an 'attention_module'/'attention_cls' "
+        "field; construct it with BertSparseSelfAttention directly (flax "
+        "modules cannot be mutated in place)".format(type(model).__name__))

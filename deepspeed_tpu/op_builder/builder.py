@@ -80,7 +80,7 @@ class OpBuilder(object):
         return os.path.join(self._build_dir(),
                             "lib{}_{}.so".format(self.name, self._signature()))
 
-    def jit_load(self, verbose=True):
+    def jit_load(self, verbose=True, _retry=True):
         """Compile (if needed) and dlopen the op (reference builder.py:182-220)."""
         if not self.is_compatible():
             raise RuntimeError(
@@ -113,11 +113,18 @@ class OpBuilder(object):
         try:
             return self._bind(ctypes.CDLL(lib))
         except OSError as e:
+            if not _retry:
+                # Fresh build still won't dlopen (ABI/linker issue, missing
+                # runtime lib): surface as RuntimeError so callers' numpy
+                # fallbacks engage instead of looping on rebuilds.
+                raise RuntimeError(
+                    "op {} built but cannot be loaded: {}".format(
+                        self.name, e))
             # Corrupt cache entry (e.g. from a pre-atomic-rename build):
             # drop it and rebuild once.
             logger.warning("Cached op %s unloadable (%s); rebuilding", lib, e)
             os.unlink(lib)
-            return self.jit_load(verbose=verbose)
+            return self.jit_load(verbose=verbose, _retry=False)
 
     def load(self, verbose=True):
         if self._loaded is None:

@@ -1,4 +1,6 @@
 from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
 from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
 from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
                                            DeepSpeedTransformerLayer)
+from deepspeed_tpu.ops import sparse_attention  # noqa: F401

@@ -160,6 +160,14 @@ class DeepSpeedCPUAdam(object):
                         "exp_avg_sq": np.zeros_like(p["params"]),
                     }
                 st = self.state[key]
+                for name in ("params", "grads"):
+                    if not p[name].flags["C_CONTIGUOUS"]:
+                        # ravel() on a non-contiguous array copies; the
+                        # in-place update would land in the temporary.
+                        raise ValueError(
+                            "CPUAdam.step requires C-contiguous {} arrays "
+                            "(got a strided view; use np.ascontiguousarray)"
+                            .format(name))
                 self.step_flat(p["params"].ravel(), p["grads"].ravel(),
                                st["exp_avg"].ravel(),
                                st["exp_avg_sq"].ravel(), step=self._step,

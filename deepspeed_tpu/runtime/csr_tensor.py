@@ -60,3 +60,45 @@ class CSRTensor(object):
 
     def __repr__(self):
         return self.__str__()
+
+
+def pad_csr(indices, values, target_rows):
+    """Pad a CSR pair to a fixed row count for collective exchange.
+
+    Padding rows point at index 0 with all-zero values, so scatter-add in
+    ``to_dense`` is unaffected (the reference's dim-padded allgather,
+    engine.py:1186-1242, pads the same way before exchanging).
+    """
+    import jax.numpy as jnp
+    k = indices.shape[0]
+    if k > target_rows:
+        raise ValueError(
+            "pad_csr: {} nonzero rows exceed the exchange budget of {} — "
+            "raise target_rows or gradients would be silently dropped"
+            .format(k, target_rows))
+    if k == target_rows:
+        return indices, values
+    pad_n = target_rows - k
+    idx = jnp.concatenate([indices, jnp.zeros((pad_n,), indices.dtype)])
+    val = jnp.concatenate(
+        [values, jnp.zeros((pad_n,) + values.shape[1:], values.dtype)])
+    return idx, val
+
+
+def csr_allreduce(indices, values, axis_name, average=True):
+    """Sparse gradient allreduce over a mesh axis: all_gather the (padded)
+    index/value pairs instead of dense-allreducing the full embedding table
+    (reference csr_allreduce_no_retain → engine.py:1186-1242).
+
+    Use inside shard_map; every rank must pass equal shapes (pad_csr).
+    Returns the merged (indices, values) with duplicates left in place —
+    CSRTensor.to_dense scatter-*adds*, which sums contributions.
+    """
+    import jax
+    w = jax.lax.psum(1, axis_name)
+    idx_g = jax.lax.all_gather(indices, axis_name)      # [W, k]
+    val_g = jax.lax.all_gather(values, axis_name)       # [W, k, ...]
+    if average:
+        val_g = val_g / w
+    return (idx_g.reshape((-1,)),
+            val_g.reshape((-1,) + val_g.shape[2:]))

@@ -632,6 +632,14 @@ class DeepSpeedEngine(object):
                                     traced_kwargs.keys(), self.training)
         out, grads = fwd_bwd(self.params, inputs, traced_kwargs,
                              self._next_rng(), scale)
+        if getattr(self, "flops_profiler", None) is not None and \
+                self.flops_profiler.started:
+            # Exact program cost from XLA (fwd+bwd in one program); the
+            # example batch feeds the per-module tabulation report.
+            if self.flops_profiler._example_args is None:
+                self.flops_profiler.set_example_batch(*inputs)
+            self.flops_profiler.observe(fwd_bwd, self.params, inputs,
+                                        traced_kwargs, self._next_rng(), scale)
         if self.training:
             self._cached_grads = grads
 
@@ -653,6 +661,22 @@ class DeepSpeedEngine(object):
         inserted by XLA (reference engine.py:832-846 does explicit bucketed
         allreduce). Kept for API parity."""
         return None
+
+    def csr_allreduce_no_retain(self, csr_list):
+        """Average a list of CSRTensors across data-parallel workers
+        (reference csr_allreduce_no_retain, engine.py:1186-1200).
+
+        Single-controller GSPMD: the per-worker dense grads were already
+        averaged inside the jitted program, so the host-visible CSR values
+        are global — only the 1/N scaling semantics remain. Multi-controller
+        shard_map pipelines use runtime.csr_tensor.csr_allreduce directly.
+        """
+        from deepspeed_tpu.runtime.csr_tensor import CSRTensor
+        return [CSRTensor(indices=c.indices, values=c.values,
+                          dense_size=c.dense_size) for c in csr_list]
+
+    def sparse_allreduce_bucket(self, bucket):
+        return self.csr_allreduce_no_retain(bucket)
 
     def backward(self, loss, allreduce_gradients=True, release_loss=False):
         """Accumulate the gradients computed in :meth:`forward`.
@@ -773,7 +797,15 @@ class DeepSpeedEngine(object):
             # Keyed off applied updates (the jitted state['step']), not
             # global_steps, so fp16 overflow-skipped steps don't desync the
             # host flag from the compiled phase switch.
+            was_frozen = getattr(self.optimizer, "adam_freeze_key", None)
             self.optimizer.notify_step(self.global_steps - self.skipped_steps)
+            if was_frozen is not None and \
+                    was_frozen != self.optimizer.adam_freeze_key:
+                # The phase flag is traced into the compiled update program
+                # on the shard_map path; drop the cache so the frozen phase
+                # re-traces (the cond path is phase-agnostic but re-jitting
+                # once is harmless).
+                self._update_fn = None
 
     # ------------------------------------------------------- ZeRO-Offload tier
 

@@ -1,0 +1,6 @@
+from deepspeed_tpu.runtime.fp16.loss_scaler import (CreateLossScaler,
+                                                    DynamicLossScaler,
+                                                    LossScaler,
+                                                    LossScalerBase)
+from deepspeed_tpu.runtime.fp16.fused_optimizer import FP16_Optimizer
+from deepspeed_tpu.runtime.fp16.unfused_optimizer import FP16_UnfusedOptimizer

@@ -1,0 +1,151 @@
+"""FP16_Optimizer — fp16 training with fp32 master weights + dynamic loss
+scaling (reference deepspeed/runtime/fp16/fused_optimizer.py:17-429).
+
+On TPU the engine integrates this machinery (bf16 needs none of it; fp16
+configs get a DynamicLossScaler + overflow-skip inside
+DeepSpeedEngine._take_model_step). This class provides the same *standalone*
+API surface for users who drove the reference optimizer directly: wraps an
+inner optimizer, owns the loss scaler, checks overflow, skips steps, clips,
+and keeps fp32 master params while handing back compute-dtype copies.
+
+Functional orientation: params/grads/state are pytrees; ``step`` returns the
+overflow bool exactly like the reference (fused_optimizer.py:176-240).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (CreateLossScaler,
+                                                    DynamicLossScaler)
+from deepspeed_tpu.runtime.utils import clip_grad_norm_, has_overflow
+from deepspeed_tpu.utils.logging import logger
+
+
+class FP16_Optimizer(object):
+    def __init__(self,
+                 init_optimizer,
+                 static_loss_scale=1.0,
+                 dynamic_loss_scale=False,
+                 initial_dynamic_scale=2 ** 32,
+                 dynamic_loss_args=None,
+                 verbose=True,
+                 mpu=None,
+                 clip_grad=0.0,
+                 fused_adam_legacy=False):
+        self.optimizer = init_optimizer
+        self.fused_adam_legacy = fused_adam_legacy
+        self.clip_grad = clip_grad
+        self.mpu = mpu
+        self.verbose = verbose
+
+        if dynamic_loss_scale:
+            args = dict(dynamic_loss_args or {})
+            args.setdefault("init_scale", initial_dynamic_scale)
+            self.loss_scaler = DynamicLossScaler(**args)
+        else:
+            self.loss_scaler = CreateLossScaler(
+                dynamic_scaling=False,
+                static_loss_scale=static_loss_scale,
+                dynamic_loss_args=None)
+        self.overflow = False
+        self.skipped_steps = 0
+
+        # jitted core: unscale + clip + inner update, one fused program
+        self._update_fn = None
+
+    # --------------------------------------------------------------- scaling
+    @property
+    def cur_scale(self):
+        return self.loss_scaler.loss_scale
+
+    def backward(self, loss, create_graph=False, retain_graph=False):
+        """Scale the loss (reference fused_optimizer.py:158-174). In JAX the
+        caller multiplies before grad; returned for symmetric usage:
+        ``scaled = fp16_opt.backward(loss)``."""
+        return loss * self.loss_scaler.loss_scale
+
+    def init_state(self, params):
+        return self.optimizer.init_state(params)
+
+    def _get_update(self):
+        if self._update_fn is None:
+            optimizer = self.optimizer
+            clip = self.clip_grad
+
+            def update(params, grads, state, inv_scale, lr, beta1, beta2):
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32) * inv_scale, grads)
+                if clip > 0.0:
+                    grads, _ = clip_grad_norm_(grads, clip)
+                return optimizer.update(params, grads, state, lr=lr,
+                                        betas=(beta1, beta2))
+
+            # No buffer donation: standalone users may hold references to the
+            # inputs (the engine's integrated path donates instead).
+            self._update_fn = jax.jit(update)
+        return self._update_fn
+
+    def step(self, params, grads, state, closure=None):
+        """One optimizer step over scaled fp16 grads.
+
+        Returns (params, state, overflow) — overflow True means the step was
+        skipped and the scale reduced (reference fused_optimizer.py:176-240).
+        """
+        self.overflow = bool(jax.device_get(jax.jit(has_overflow)(grads)))
+        prev_scale = self.cur_scale
+        self.loss_scaler.update_scale(self.overflow)
+        if self.overflow:
+            self.skipped_steps += 1
+            if self.verbose:
+                logger.info(
+                    "[deepspeed] OVERFLOW! Rank 0 Skipping step. Attempted "
+                    "loss scale: %s, reducing to %s", prev_scale,
+                    self.cur_scale)
+            return params, state, True
+
+        group = self.optimizer.param_groups[0]
+        beta1, beta2 = group.get("betas", (0.9, 0.999))
+        params, state = self._get_update()(
+            params, grads, state,
+            jnp.float32(1.0 / prev_scale),
+            jnp.float32(group["lr"]), jnp.float32(beta1), jnp.float32(beta2))
+        return params, state, False
+
+    # ------------------------------------------------------------ state_dict
+    @property
+    def param_groups(self):
+        """Forward to the inner optimizer (reference :374-379 property)."""
+        return self.optimizer.param_groups
+
+    def state_dict(self):
+        sd = {
+            "dynamic_loss_scale": isinstance(self.loss_scaler,
+                                             DynamicLossScaler),
+            "cur_scale": self.loss_scaler.cur_scale,
+            "skipped_steps": self.skipped_steps,
+            "overflow": self.overflow,
+            "clip_grad": self.clip_grad,
+        }
+        if isinstance(self.loss_scaler, DynamicLossScaler):
+            sd["cur_iter"] = self.loss_scaler.cur_iter
+            sd["last_overflow_iter"] = self.loss_scaler.last_overflow_iter
+            sd["scale_factor"] = self.loss_scaler.scale_factor
+            sd["scale_window"] = self.loss_scaler.scale_window
+        if hasattr(self.optimizer, "state_dict"):
+            sd["optimizer_state_dict"] = self.optimizer.state_dict()
+        return sd
+
+    def load_state_dict(self, sd, load_optimizer_states=True):
+        self.loss_scaler.cur_scale = sd.get("cur_scale",
+                                            self.loss_scaler.cur_scale)
+        self.skipped_steps = sd.get("skipped_steps", 0)
+        self.overflow = sd.get("overflow", False)
+        self.clip_grad = sd.get("clip_grad", self.clip_grad)
+        if isinstance(self.loss_scaler, DynamicLossScaler):
+            for k in ("cur_iter", "last_overflow_iter", "scale_factor",
+                      "scale_window"):
+                if k in sd:
+                    setattr(self.loss_scaler, k, sd[k])
+        if load_optimizer_states and "optimizer_state_dict" in sd and \
+                hasattr(self.optimizer, "load_state_dict"):
+            self.optimizer.load_state_dict(sd["optimizer_state_dict"])

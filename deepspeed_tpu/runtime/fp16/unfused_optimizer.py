@@ -1,0 +1,37 @@
+"""FP16_UnfusedOptimizer — per-tensor fp32 master copies, used for LAMB
+(reference deepspeed/runtime/fp16/unfused_optimizer.py:17-376).
+
+The fused/unfused distinction on GPU is about master-weight memory layout
+(one flat buffer vs per-tensor copies) and which kernel consumes them. Under
+XLA both compile to the same fused update program, so this class shares the
+FP16_Optimizer core and differs only in the LAMB-specific step entry
+(``step_fused_lamb``, reference :118-174) and in never flattening state —
+kept as a distinct class so reference call sites port unchanged.
+"""
+
+from deepspeed_tpu.runtime.fp16.fused_optimizer import FP16_Optimizer
+
+
+class FP16_UnfusedOptimizer(FP16_Optimizer):
+    def __init__(self,
+                 init_optimizer,
+                 static_loss_scale=1.0,
+                 dynamic_loss_scale=False,
+                 dynamic_loss_args=None,
+                 verbose=True,
+                 mpu=None,
+                 clip_grad=0.0,
+                 fused_lamb_legacy=False):
+        super().__init__(init_optimizer,
+                         static_loss_scale=static_loss_scale,
+                         dynamic_loss_scale=dynamic_loss_scale,
+                         dynamic_loss_args=dynamic_loss_args,
+                         verbose=verbose,
+                         mpu=mpu,
+                         clip_grad=clip_grad)
+        self.fused_lamb_legacy = fused_lamb_legacy
+
+    def step_fused_lamb(self, params, grads, state, closure=None):
+        """LAMB step with overflow handling (reference :118-174); the trust
+        ratio lives in the inner FusedLamb update."""
+        return self.step(params, grads, state, closure=closure)

@@ -1,0 +1,97 @@
+"""FP16_Optimizer / FP16_UnfusedOptimizer tests (mirror reference
+tests/unit/test_fp16.py + test_dynamic_loss_scale.py behavior slices).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+from deepspeed_tpu.runtime.fp16.fused_optimizer import FP16_Optimizer
+from deepspeed_tpu.runtime.fp16.unfused_optimizer import FP16_UnfusedOptimizer
+
+
+def _setup(opt_cls=FusedAdam, **kw):
+    params = {"w": jnp.asarray(np.random.RandomState(0).randn(8)
+                               .astype(np.float32))}
+    inner = opt_cls(lr=1e-2)
+    fp16 = opt_cls is FusedAdam and FP16_Optimizer or FP16_UnfusedOptimizer
+    opt = fp16(inner, dynamic_loss_scale=True,
+               dynamic_loss_args={"init_scale": 2 ** 8, "scale_window": 2,
+                                  "delayed_shift": 1}, **kw)
+    state = opt.init_state(params)
+    return params, opt, state
+
+
+def test_normal_step_unscales_grads():
+    params, opt, state = _setup()
+    scale = opt.cur_scale
+    grads = {"w": jnp.ones(8) * scale}  # pre-scaled grads of 1.0
+    p2, s2, overflow = opt.step(params, grads, state)
+    assert not overflow
+    # equivalent unscaled-grad update
+    inner = FusedAdam(lr=1e-2)
+    ref_p, _ = inner.update(params, {"w": jnp.ones(8)},
+                            inner.init_state(params))
+    np.testing.assert_allclose(np.asarray(p2["w"]), np.asarray(ref_p["w"]),
+                               rtol=1e-6)
+
+
+def test_overflow_skips_and_reduces_scale():
+    params, opt, state = _setup()
+    scale0 = opt.cur_scale
+    grads = {"w": jnp.full((8,), jnp.inf)}
+    p2, s2, overflow = opt.step(params, grads, state)
+    assert overflow
+    assert opt.skipped_steps == 1
+    assert opt.cur_scale == scale0 / 2
+    np.testing.assert_array_equal(np.asarray(p2["w"]), np.asarray(params["w"]))
+    np.testing.assert_array_equal(np.asarray(s2["exp_avg"]["w"]),
+                                  np.asarray(state["exp_avg"]["w"]))
+
+
+def test_scale_window_growth():
+    params, opt, state = _setup()
+    scale0 = opt.cur_scale
+    grads = {"w": jnp.ones(8)}
+    for _ in range(2):  # scale_window=2 clean steps
+        params, state, _ = opt.step(params, grads, state)
+    assert opt.cur_scale == scale0 * 2
+
+
+def test_backward_scales_loss():
+    _, opt, _ = _setup()
+    loss = jnp.float32(2.0)
+    assert float(opt.backward(loss)) == 2.0 * opt.cur_scale
+
+
+def test_clip_grad():
+    params, opt, state = _setup(clip_grad=0.1)
+    big = {"w": jnp.ones(8) * opt.cur_scale * 100}
+    p2, s2, overflow = opt.step(params, big, state)
+    assert not overflow  # big but finite
+
+
+def test_state_dict_roundtrip():
+    params, opt, state = _setup()
+    grads = {"w": jnp.full((8,), jnp.inf)}
+    opt.step(params, grads, state)
+    sd = opt.state_dict()
+    assert sd["skipped_steps"] == 1 and sd["overflow"]
+
+    _, opt2, _ = _setup()
+    opt2.load_state_dict(sd)
+    assert opt2.skipped_steps == 1
+    assert opt2.cur_scale == opt.cur_scale
+    assert opt2.loss_scaler.cur_iter == opt.loss_scaler.cur_iter
+
+
+def test_unfused_lamb_step():
+    params = {"w": jnp.asarray(np.random.RandomState(1).randn(8)
+                               .astype(np.float32))}
+    opt = FP16_UnfusedOptimizer(FusedLamb(lr=1e-2), static_loss_scale=4.0)
+    state = opt.init_state(params)
+    grads = {"w": jnp.ones(8) * 4.0}
+    p2, s2, overflow = opt.step_fused_lamb(params, grads, state)
+    assert not overflow
+    assert not np.allclose(np.asarray(p2["w"]), np.asarray(params["w"]))

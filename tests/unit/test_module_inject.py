@@ -1,0 +1,126 @@
+"""Module injection tests: HF-BERT layer params ⇄ fused layer packing
+round-trip and numeric equivalence (reference replace_module.py:6-157,
+exercised by tests/unit via BingBert configs).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.module_inject import (pack_bert_layer, replace_module,
+                                         replace_transformer_layer,
+                                         revert_transformer_layer,
+                                         unpack_bert_layer)
+from deepspeed_tpu.ops.transformer import DeepSpeedTransformerLayer
+
+
+@dataclasses.dataclass
+class HFBertConfig:
+    hidden_size: int = 32
+    num_attention_heads: int = 4
+    intermediate_size: int = 64
+    hidden_dropout_prob: float = 0.0
+    attention_probs_dropout_prob: float = 0.0
+    num_hidden_layers: int = 2
+
+
+def _hf_layer_params(rng, h, inter):
+    def dense(key, i, o):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(key))
+        return {"kernel": jax.random.normal(k1, (i, o)) * 0.02,
+                "bias": jax.random.normal(k2, (o,)) * 0.01}
+
+    return {
+        "attention": {
+            "self": {
+                "query": dense(0, h, h),
+                "key": dense(1, h, h),
+                "value": dense(2, h, h),
+            },
+            "output": {
+                "dense": dense(3, h, h),
+                "LayerNorm": {"scale": jnp.ones(h), "bias": jnp.zeros(h)},
+            },
+        },
+        "intermediate": {"dense": dense(4, h, inter)},
+        "output": {
+            "dense": dense(5, inter, h),
+            "LayerNorm": {"scale": jnp.ones(h), "bias": jnp.zeros(h)},
+        },
+    }
+
+
+def test_pack_unpack_roundtrip():
+    layer = _hf_layer_params(0, 32, 64)
+    packed = pack_bert_layer(layer)
+    assert packed["attn_qkvw"].shape == (96, 32)
+    assert packed["inter_w"].shape == (64, 32)
+    restored = revert = unpack_bert_layer(packed)
+    flat_a = jax.tree_util.tree_leaves(layer)
+    flat_b = jax.tree_util.tree_leaves(restored)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_replace_transformer_layer_output_matches_hf_forward():
+    """Fused layer with packed params == hand-computed HF BertLayer forward
+    (post-LN), the parity the reference checks via vendored modeling.py."""
+    cfg = HFBertConfig()
+    h, inter = cfg.hidden_size, cfg.intermediate_size
+    hf = {"encoder": {"layer_0": _hf_layer_params(0, h, inter)}}
+
+    layer, new_params = replace_transformer_layer(
+        model=None, params=hf, micro_batch_size=2, bert_config=cfg,
+        fp16=False, training=False, max_seq_length=16)
+    ds_params = new_params["encoder"]["layer_0"]
+
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 16, h))
+    out = layer.apply({"params": ds_params}, x, deterministic=True)
+
+    # hand-computed HF forward (post-LN, GELU)
+    lp = hf["encoder"]["layer_0"]
+    sa = lp["attention"]["self"]
+
+    def d(p, v):
+        return v @ p["kernel"] + p["bias"]
+
+    q = d(sa["query"], x).reshape(2, 16, 4, 8).transpose(0, 2, 1, 3)
+    k = d(sa["key"], x).reshape(2, 16, 4, 8).transpose(0, 2, 1, 3)
+    v = d(sa["value"], x).reshape(2, 16, 4, 8).transpose(0, 2, 1, 3)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(8)
+    p = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", p, v).transpose(0, 2, 1, 3).reshape(2, 16, h)
+    ao = lp["attention"]["output"]
+
+    def ln(z, g):  # layer norm with scale/bias dict g
+        mu = z.mean(-1, keepdims=True)
+        var = z.var(-1, keepdims=True)
+        return (z - mu) / jnp.sqrt(var + 1e-12) * g["scale"] + g["bias"]
+
+    a = ln(d(ao["dense"], ctx) + x, ao["LayerNorm"])
+    ff = jax.nn.gelu(d(lp["intermediate"]["dense"], a), approximate=False)
+    hf_out = ln(d(lp["output"]["dense"], ff) + a, lp["output"]["LayerNorm"])
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(hf_out),
+                               rtol=2e-2, atol=2e-3)
+
+
+def test_revert_after_replace_identity():
+    cfg = HFBertConfig()
+    hf = {"m": _hf_layer_params(3, 32, 64)}
+    _, packed = replace_transformer_layer(params=hf, bert_config=cfg)
+    restored = revert_transformer_layer(params=packed)
+    for a, b in zip(jax.tree_util.tree_leaves(hf),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_generic_replace_module():
+    tree = {"a": {"x": 1}, "b": {"target": True, "v": 2}}
+    out = replace_module(tree,
+                         lambda t: isinstance(t, dict) and t.get("target"),
+                         lambda t: {"replaced": t["v"]})
+    assert out["b"] == {"replaced": 2}
+    assert out["a"] == {"x": 1}
