@@ -1,0 +1,77 @@
+"""ds_report — environment / op compatibility report
+(reference deepspeed/env_report.py:23-50: prints the op install/compat matrix
+and torch/cuda versions; here jax/libtpu and the TPU op registry).
+"""
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+SUCCESS = GREEN + "[YES]" + END
+WARNING = YELLOW + "[WARNING]" + END
+FAIL = RED + "[NO]" + END
+OKAY = GREEN + "[OKAY]" + END
+
+
+def op_report():
+    from deepspeed_tpu.op_builder import ALL_OPS
+    max_dots = 23
+    print("-" * 64)
+    print("DeepSpeed-TPU ops report")
+    print("-" * 64)
+    print("op name" + "." * (max_dots - len("op name")) + "compatible")
+    print("-" * 64)
+    rows = []
+    for op_name, builder_cls in ALL_OPS.items():
+        builder = builder_cls()
+        compat = builder.is_compatible()
+        status = OKAY if compat else FAIL
+        kind = "pallas" if not builder.sources() else "c++"
+        line = "{} [{}]{}{}".format(
+            op_name, kind, "." * max(max_dots - len(op_name) - len(kind) - 3,
+                                     1), status)
+        print(line)
+        rows.append((op_name, kind, compat))
+    print("-" * 64)
+    return rows
+
+
+def version_report():
+    import jax
+    import jaxlib
+    print("DeepSpeed-TPU general environment info:")
+    try:
+        import deepspeed_tpu
+        print("deepspeed install path ...", deepspeed_tpu.__path__)
+        print("deepspeed info ...........", deepspeed_tpu.__version__)
+    except Exception:
+        pass
+    print("jax version ..............", jax.__version__)
+    print("jaxlib version ...........", jaxlib.__version__)
+    try:
+        backend = jax.default_backend()
+        devices = jax.devices()
+        print("jax backend ..............", backend)
+        print("device count .............", len(devices))
+        print("device kind ..............",
+              devices[0].device_kind if devices else "none")
+    except Exception as e:  # no accelerator / no device grant
+        print("jax backend ..............", "unavailable ({})".format(e))
+    try:
+        import flax
+        print("flax version .............", flax.__version__)
+    except ImportError:
+        print("flax version .............", "not installed")
+
+
+def main():
+    op_report()
+    version_report()
+
+
+def cli_main():
+    main()
+
+
+if __name__ == "__main__":
+    main()

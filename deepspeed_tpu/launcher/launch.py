@@ -1,0 +1,73 @@
+"""Per-node launcher (reference deepspeed/launcher/launch.py:65-129).
+
+The reference sets MASTER_ADDR/PORT/WORLD_SIZE and spawns one subprocess per
+local GPU with ``--local_rank=i`` and CUDA_VISIBLE_DEVICES. On TPU the JAX
+runtime is one process per host: this launcher sets the coordinator env
+(consumed by ``deepspeed_tpu.utils.distributed.init_distributed`` →
+``jax.distributed.initialize``) and execs the user script ONCE; all local
+chips belong to that process.
+"""
+
+import argparse
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="DeepSpeed-TPU per-node launcher")
+    parser.add_argument("--node_rank", type=int, default=0,
+                        help="Rank of this node in the job")
+    parser.add_argument("--master_addr", default="127.0.0.1", type=str,
+                        help="Coordinator (node-0) address")
+    parser.add_argument("--master_port", default=29500, type=int,
+                        help="Coordinator port")
+    parser.add_argument("--world_info", default="e30=", type=str,
+                        help="base64-encoded {hostname: [slots]} dictionary")
+    parser.add_argument("training_script", type=str,
+                        help="User training script")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    world_info = json.loads(
+        base64.urlsafe_b64decode(args.world_info).decode("utf-8"))
+    num_nodes = max(len(world_info), 1)
+
+    env = os.environ.copy()
+    env["MASTER_ADDR"] = args.master_addr
+    env["MASTER_PORT"] = str(args.master_port)
+    # One controller process per host (not per chip): RANK is the node rank
+    # and WORLD_SIZE the node count — jax.distributed's process model.
+    env["RANK"] = str(args.node_rank)
+    env["WORLD_SIZE"] = str(num_nodes)
+    env["LOCAL_RANK"] = "0"
+    env["CROSS_RANK"] = str(args.node_rank)
+    env["CROSS_SIZE"] = str(num_nodes)
+
+    logger.info("launch: node_rank=%s world_size=%s coordinator=%s:%s",
+                args.node_rank, num_nodes, args.master_addr, args.master_port)
+
+    cmd = [sys.executable, "-u", args.training_script,
+           "--local_rank=0"] + args.training_script_args
+    process = subprocess.Popen(cmd, env=env)
+
+    def sig_handler(signum, frame):
+        process.send_signal(signum)
+
+    signal.signal(signal.SIGTERM, sig_handler)
+    signal.signal(signal.SIGINT, sig_handler)
+    process.wait()
+    sys.exit(process.returncode)
+
+
+if __name__ == "__main__":
+    main()

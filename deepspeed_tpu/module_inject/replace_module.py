@@ -62,6 +62,9 @@ def unpack_bert_layer(ds):
     """Inverse of :func:`pack_bert_layer` (reference revert_transformer_layer,
     replace_module.py:92-157)."""
     h = ds["attn_ow"].shape[0]
+    if ds["attn_qkvw"].shape != (3 * h, h):
+        raise ValueError("attn_qkvw shape {} inconsistent with hidden {}"
+                         .format(ds["attn_qkvw"].shape, h))
     qw, kw, vw = jnp.split(ds["attn_qkvw"], 3, axis=0)
     qb, kb, vb = jnp.split(ds["attn_qkvb"], 3)
 

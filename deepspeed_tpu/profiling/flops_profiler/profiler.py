@@ -96,7 +96,10 @@ class FlopsProfiler(object):
             # lower().compile() re-traces from scratch; cache per program so
             # a profiled training window pays one AOT compile, not one per
             # step.
-            key = id(jitted_fn)
+            shapes = tuple(
+                (getattr(x, "shape", None), str(getattr(x, "dtype", type(x))))
+                for x in jax.tree_util.tree_leaves((args, kwargs)))
+            key = (id(jitted_fn), shapes)
             if key not in self._cost_cache:
                 compiled = jitted_fn.lower(*args, **kwargs).compile()
                 cost = compiled.cost_analysis()

@@ -638,8 +638,12 @@ class DeepSpeedEngine(object):
             # example batch feeds the per-module tabulation report.
             if self.flops_profiler._example_args is None:
                 self.flops_profiler.set_example_batch(*inputs)
+            # Constant key: observe() only needs shapes/dtypes for lowering;
+            # splitting the engine RNG here would make profiling perturb
+            # training.
             self.flops_profiler.observe(fwd_bwd, self.params, inputs,
-                                        traced_kwargs, self._next_rng(), scale)
+                                        traced_kwargs,
+                                        jax.random.PRNGKey(0), scale)
         if self.training:
             self._cached_grads = grads
 

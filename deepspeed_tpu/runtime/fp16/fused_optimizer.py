@@ -140,7 +140,9 @@ class FP16_Optimizer(object):
                                             self.loss_scaler.cur_scale)
         self.skipped_steps = sd.get("skipped_steps", 0)
         self.overflow = sd.get("overflow", False)
-        self.clip_grad = sd.get("clip_grad", self.clip_grad)
+        if sd.get("clip_grad", self.clip_grad) != self.clip_grad:
+            self.clip_grad = sd["clip_grad"]
+            self._update_fn = None  # jitted closure baked in the old clip
         if isinstance(self.loss_scaler, DynamicLossScaler):
             for k in ("cur_iter", "last_overflow_iter", "scale_factor",
                       "scale_window"):

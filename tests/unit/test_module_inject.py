@@ -57,7 +57,7 @@ def test_pack_unpack_roundtrip():
     packed = pack_bert_layer(layer)
     assert packed["attn_qkvw"].shape == (96, 32)
     assert packed["inter_w"].shape == (64, 32)
-    restored = revert = unpack_bert_layer(packed)
+    restored = unpack_bert_layer(packed)
     flat_a = jax.tree_util.tree_leaves(layer)
     flat_b = jax.tree_util.tree_leaves(restored)
     for a, b in zip(flat_a, flat_b):

@@ -1,0 +1,146 @@
+"""Launcher tests (mirror reference tests/unit/test_run.py: hostfile parsing
+and include/exclude filters, plus world-info encode/decode and ds_report).
+"""
+
+import base64
+import json
+
+import pytest
+
+from deepspeed_tpu.launcher import runner as dsrun
+
+
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("worker-0 slots=4\nworker-1 slots=4\n\n")
+    pool = dsrun.fetch_hostfile(str(hf))
+    assert list(pool.keys()) == ["worker-0", "worker-1"]
+    assert pool["worker-0"] == 4
+
+
+def test_missing_hostfile_returns_none(tmp_path):
+    assert dsrun.fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_malformed_hostfile_raises(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("worker-0 slots=four\n")
+    with pytest.raises(ValueError):
+        dsrun.fetch_hostfile(str(hf))
+
+
+def test_duplicate_host_raises(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("worker-0 slots=4\nworker-0 slots=2\n")
+    with pytest.raises(ValueError):
+        dsrun.fetch_hostfile(str(hf))
+
+
+def _pool():
+    import collections
+    return collections.OrderedDict([("worker-0", 4), ("worker-1", 4)])
+
+
+def test_include_whole_node():
+    active = dsrun.parse_inclusion_exclusion(_pool(), "worker-0", "")
+    assert list(active.keys()) == ["worker-0"]
+    assert active["worker-0"] == [0, 1, 2, 3]
+
+
+def test_include_slots():
+    active = dsrun.parse_inclusion_exclusion(_pool(), "worker-1:0,2", "")
+    assert active == {"worker-1": [0, 2]}
+
+
+def test_include_multiple_nodes():
+    active = dsrun.parse_inclusion_exclusion(_pool(),
+                                             "worker-0@worker-1:0,2", "")
+    assert active["worker-0"] == [0, 1, 2, 3]
+    assert active["worker-1"] == [0, 2]
+
+
+def test_exclude_slot():
+    active = dsrun.parse_inclusion_exclusion(_pool(), "", "worker-1:0")
+    assert active["worker-0"] == [0, 1, 2, 3]
+    assert active["worker-1"] == [1, 2, 3]
+
+
+def test_exclude_whole_node():
+    active = dsrun.parse_inclusion_exclusion(_pool(), "", "worker-0")
+    assert list(active.keys()) == ["worker-1"]
+
+
+def test_include_exclude_mutually_exclusive():
+    with pytest.raises(ValueError):
+        dsrun.parse_inclusion_exclusion(_pool(), "worker-0", "worker-1")
+
+
+def test_unknown_host_raises():
+    with pytest.raises(ValueError):
+        dsrun.parse_inclusion_exclusion(_pool(), "worker-9", "")
+
+
+def test_unknown_slot_raises():
+    with pytest.raises(ValueError):
+        dsrun.parse_inclusion_exclusion(_pool(), "worker-0:9", "")
+
+
+def test_encode_world_info_roundtrip():
+    info = {"worker-0": [0, 1], "worker-1": [0]}
+    enc = dsrun.encode_world_info(info)
+    dec = json.loads(base64.urlsafe_b64decode(enc).decode())
+    assert dec == info
+
+
+def test_pdsh_runner_cmd():
+    args = dsrun.parse_args(["--master_addr", "10.0.0.1",
+                             "--master_port", "29500",
+                             "train.py", "--deepspeed_config", "ds.json"])
+    from deepspeed_tpu.launcher.multinode_runner import PDSHRunner
+    r = PDSHRunner(args, "e30=")
+    r.add_export("JAX_FOO", "1")
+    cmd = r.get_cmd({}, _pool())
+    s = " ".join(cmd)
+    assert "pdsh" in cmd[0]
+    assert "worker-0,worker-1" in s
+    assert "--node_rank=%n" in s
+    assert "deepspeed_tpu.launcher.launch" in s
+    assert "export JAX_FOO=1" in s
+    assert "train.py" in s
+
+
+def test_openmpi_runner_one_rank_per_host():
+    args = dsrun.parse_args(["train.py"])
+    from deepspeed_tpu.launcher.multinode_runner import OpenMPIRunner
+    r = OpenMPIRunner(args, "e30=", _pool())
+    cmd = r.get_cmd({}, _pool())
+    # one process per HOST, not per slot
+    assert cmd[cmd.index("-n") + 1] == "2"
+
+
+def test_ds_report_runs(capsys):
+    from deepspeed_tpu.env_report import main
+    main()
+    out = capsys.readouterr().out
+    assert "cpu_adam" in out
+    assert "jax version" in out
+    assert "sparse_attn" in out
+
+
+def test_elastic_config_entry():
+    from deepspeed_tpu.elasticity import compute_elastic_config
+    ds_config = {
+        "train_batch_size": None,
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 2000,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 64,
+            "min_time": 20,
+            "version": 0.1,
+        },
+    }
+    from deepspeed_tpu.version import version as ds_version
+    batch, valid = compute_elastic_config(ds_config, ds_version)
+    assert batch > 0 and len(valid) > 0
