@@ -32,7 +32,10 @@ def main():
     # tiny on CPU (so the harness still runs end-to-end anywhere).
     on_tpu = platform == "tpu"
     if on_tpu:
-        cfg = GPT2Config.gpt2_medium(dropout=0.0)
+        # Measured-best single-chip config (v5e): dense XLA attention at
+        # T=1024 beats the flash kernel; chunked-XE loss keeps logits out of
+        # HBM so batch 8 fits without remat.
+        cfg = GPT2Config.gpt2_medium(dropout=0.0, use_flash_attention=False)
         batch, seq, steps = 8, 1024, 20
         peak_flops = 197e12  # v5e bf16 peak per chip
     else:
