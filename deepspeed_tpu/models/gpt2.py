@@ -184,6 +184,9 @@ def _chunked_softmax_xent(x, wte, labels, dtype, chunk=2048):
     n = b * t
     xf = x.reshape(n, c)
     lf = labels.reshape(n)
+    # Small batches: shrink the chunk (rounded to the 128-lane register
+    # width) so padding never multiplies the head-GEMM work.
+    chunk = min(chunk, max(128, -(-n // 128) * 128))
     pad = (-n) % chunk
     if pad:
         xf = jnp.concatenate(

@@ -45,3 +45,30 @@ class LinearStack(nn.Module):
         logp = nn.log_softmax(x)
         nll = -jnp.take_along_axis(logp, y[..., None], axis=-1)[..., 0]
         return jnp.mean(nll)
+
+
+class DenseRelu(nn.Module):
+    """Toy pipeline stage: Dense (no bias) + ReLU — shared by the pipeline
+    parity tests and the multi-chip dryrun."""
+
+    features: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.relu(nn.Dense(self.features, use_bias=False)(x))
+
+
+class DenseOut(nn.Module):
+    """Toy pipeline output stage: Dense (no bias)."""
+
+    features: int = 8
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.features, use_bias=False)(x)
+
+
+def ce_loss(logits, labels):
+    """Cross-entropy on integer labels (pipeline loss_fn fixture)."""
+    logp = nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))

@@ -1,11 +1,19 @@
 """Version info for deepspeed_tpu.
 
-Mirrors the surface of the reference's git_version_info
-(/root/reference/deepspeed/git_version_info.py:1-17) without install-time codegen.
+Mirrors the reference's git_version_info (deepspeed/git_version_info.py:1-17):
+prefer the install-time stamp written by setup.py, fall back to in-tree
+defaults.
 """
 
-version = "0.3.10+tpu.r1"
-git_hash = "unknown"
-git_branch = "main"
+try:
+    from deepspeed_tpu.git_version_info_installed import (  # noqa: F401
+        version, git_hash, git_branch)
+except ImportError:
+    version = "0.3.10+tpu.r1"
+    git_hash = "unknown"
+    git_branch = "main"
+
+# Op status for ds_report parity (reference git_version_info.py keeps
+# installed/compatible op dicts; ours are computed live by env_report).
 installed_ops = {}
 compatible_ops = {}

@@ -8,28 +8,8 @@ import numpy as np
 import pytest
 
 import deepspeed_tpu as deepspeed
+from deepspeed_tpu.models.simple import DenseOut, DenseRelu, ce_loss
 from deepspeed_tpu.pipe import LayerSpec, PipelineModule, TiedLayerSpec
-
-
-class DenseRelu(nn.Module):
-    features: int = 32
-
-    @nn.compact
-    def __call__(self, x):
-        return nn.relu(nn.Dense(self.features, use_bias=False)(x))
-
-
-class DenseOut(nn.Module):
-    features: int = 8
-
-    @nn.compact
-    def __call__(self, x):
-        return nn.Dense(self.features, use_bias=False)(x)
-
-
-def ce_loss(logits, labels):
-    logp = nn.log_softmax(logits)
-    return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
 
 
 def make_pipeline(num_stages, gas=2):
