@@ -17,6 +17,26 @@ from deepspeed_tpu.utils.distributed import init_distributed  # noqa: F401
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.version import git_branch, git_hash, version as __version__
 
+__git_hash__ = git_hash
+__git_branch__ = git_branch
+
+# Backwards compatibility with the old deepspeed.pt module structure
+# (reference __init__.py:37-47).
+import sys as _sys
+import types as _types
+
+from deepspeed_tpu.runtime import config as _rt_config, utils as _rt_utils
+from deepspeed_tpu.runtime.fp16 import loss_scaler as _loss_scaler
+
+pt = _types.ModuleType("pt", "dummy pt module for backwards compatability")
+pt.deepspeed_utils = _rt_utils
+pt.deepspeed_config = _rt_config
+pt.loss_scaler = _loss_scaler
+_sys.modules[__name__ + ".pt"] = pt
+_sys.modules[__name__ + ".pt.deepspeed_utils"] = _rt_utils
+_sys.modules[__name__ + ".pt.deepspeed_config"] = _rt_config
+_sys.modules[__name__ + ".pt.loss_scaler"] = _loss_scaler
+
 
 def initialize(args=None,
                model=None,

@@ -1,0 +1,239 @@
+"""BERT family — encoder stack on the fused DeepSpeedTransformerLayer.
+
+The reference ships no models in-tree but its headline benchmark is
+BERT-large pretraining with the fused transformer kernel (BASELINE.md: 66
+TFLOPS/GPU, docs/_posts/2020-05-19-bert-record.md:14), and its kernel tests
+vendor a full BERT implementation (tests/unit/modeling.py:1578). This module
+is the TPU framework's first-class equivalent: a flax BERT whose encoder
+layers are the fused Pallas DeepSpeedTransformerLayer (opt-out to a plain
+stack), with the MLM+NSP pretraining heads, sized per bert_base/bert_large.
+"""
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                           DeepSpeedTransformerLayer)
+
+
+@dataclasses.dataclass
+class BertConfig:
+    """HF-compatible config surface (duck-typed where the reference expects
+    bert_config, e.g. module_inject/replace_module.py:6)."""
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+    dtype: Any = jnp.bfloat16
+    pre_layer_norm: bool = False
+    use_fused_layer: bool = True
+
+    @classmethod
+    def bert_base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def bert_large(cls, **kw):
+        kw.setdefault("hidden_size", 1024)
+        kw.setdefault("num_hidden_layers", 24)
+        kw.setdefault("num_attention_heads", 16)
+        kw.setdefault("intermediate_size", 4096)
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 1024)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("max_position_embeddings", 128)
+        return cls(**kw)
+
+    def num_params(self):
+        h, inter = self.hidden_size, self.intermediate_size
+        emb = (self.vocab_size + self.max_position_embeddings +
+               self.type_vocab_size) * h + 2 * h
+        per_layer = 4 * h * h + 2 * h * inter + 9 * h + inter
+        pooler = h * h + h
+        return emb + self.num_hidden_layers * per_layer + pooler
+
+    def _ds_layer_config(self, training):
+        return DeepSpeedTransformerConfig(
+            batch_size=-1,
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            heads=self.num_attention_heads,
+            attn_dropout_ratio=self.attention_probs_dropout_prob,
+            hidden_dropout_ratio=self.hidden_dropout_prob,
+            num_hidden_layers=self.num_hidden_layers,
+            initializer_range=self.initializer_range,
+            layer_norm_eps=self.layer_norm_eps,
+            pre_layer_norm=self.pre_layer_norm,
+            training=training,
+            dtype=self.dtype,
+        )
+
+
+class BertEmbeddings(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, position_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        b, t = input_ids.shape
+        ini = nn.initializers.normal(cfg.initializer_range)
+        wte = self.param("word_embeddings", ini,
+                         (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        wpe = self.param("position_embeddings", ini,
+                         (cfg.max_position_embeddings, cfg.hidden_size),
+                         jnp.float32)
+        wtt = self.param("token_type_embeddings", ini,
+                         (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
+        if position_ids is None:
+            position_ids = jnp.arange(t)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (wte[input_ids] + wpe[position_ids] + wtt[token_type_ids])
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="LayerNorm")(x)
+        x = nn.Dropout(cfg.hidden_dropout_prob)(x, deterministic=deterministic)
+        # The table rides along for weight tying in the MLM decoder.
+        return x.astype(cfg.dtype), wte
+
+
+class PlainBertLayer(nn.Module):
+    """Stock post-LN BERT encoder layer (unfused XLA path) — the opt-out when
+    use_fused_layer=False, and the module_inject swap target."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, add_mask=None, deterministic=True):
+        cfg = self.config
+        b, t, h = x.shape
+        nh, hd = cfg.num_attention_heads, h // cfg.num_attention_heads
+
+        def heads(z):
+            return z.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+
+        q = heads(nn.Dense(h, dtype=cfg.dtype, name="query")(x))
+        k = heads(nn.Dense(h, dtype=cfg.dtype, name="key")(x))
+        v = heads(nn.Dense(h, dtype=cfg.dtype, name="value")(x))
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(hd).astype(cfg.dtype)
+        if add_mask is not None:
+            s = s + add_mask[:, None, None, :].astype(s.dtype)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        p = nn.Dropout(cfg.attention_probs_dropout_prob)(
+            p, deterministic=deterministic)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, t, h)
+        a = nn.Dense(h, dtype=cfg.dtype, name="attn_out")(ctx)
+        a = nn.Dropout(cfg.hidden_dropout_prob)(a, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="attn_LayerNorm")(
+            (x + a).astype(jnp.float32)).astype(cfg.dtype)
+
+        f = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     name="intermediate")(x)
+        f = nn.gelu(f, approximate=False)
+        f = nn.Dense(h, dtype=cfg.dtype, name="output")(f)
+        f = nn.Dropout(cfg.hidden_dropout_prob)(f, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="out_LayerNorm")(
+            (x + f).astype(jnp.float32)).astype(cfg.dtype)
+
+
+class BertModel(nn.Module):
+    """Embeddings → fused encoder stack → pooled [CLS]."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        x, wte = BertEmbeddings(cfg, name="embeddings")(
+            input_ids, token_type_ids, deterministic=deterministic)
+
+        add_mask = None
+        if attention_mask is not None:
+            # HF 1/0 mask → the additive convention the kernels use
+            # (0 keep / large-negative drop, [B, T]).
+            add_mask = (1.0 - attention_mask.astype(jnp.float32)) * -1e9
+
+        layer_cfg = cfg._ds_layer_config(training=not deterministic)
+        for i in range(cfg.num_hidden_layers):
+            if cfg.use_fused_layer:
+                x = DeepSpeedTransformerLayer(
+                    config=layer_cfg, name="layer_{}".format(i))(
+                        x, attention_mask=add_mask,
+                        deterministic=deterministic)
+            else:
+                x = PlainBertLayer(cfg, name="layer_{}".format(i))(
+                    x, add_mask, deterministic=deterministic)
+
+        pooled = nn.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                                  name="pooler")(x[:, 0]))
+        return x, pooled, wte
+
+
+class BertForPreTraining(nn.Module):
+    """MLM + NSP pretraining heads. Returns the summed loss when labels are
+    given (DeepSpeed convention: model output IS the loss), else
+    (prediction_logits, seq_relationship_logits)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 masked_lm_labels=None, next_sentence_label=None,
+                 deterministic=True):
+        cfg = self.config
+        seq_out, pooled, wte = BertModel(cfg, name="bert")(
+            input_ids, attention_mask, token_type_ids,
+            deterministic=deterministic)
+
+        # MLM head: transform + LN + decoder tied to word embeddings.
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     name="transform")(seq_out)
+        h = nn.gelu(h, approximate=False)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="transform_LayerNorm")(h.astype(jnp.float32))
+        mlm_bias = self.param("mlm_bias", nn.initializers.zeros,
+                              (cfg.vocab_size,), jnp.float32)
+        prediction_logits = h @ wte.T.astype(jnp.float32) + mlm_bias
+
+        seq_relationship = nn.Dense(2, dtype=jnp.float32,
+                                    name="seq_relationship")(
+                                        pooled.astype(jnp.float32))
+
+        if masked_lm_labels is None and next_sentence_label is None:
+            return prediction_logits, seq_relationship
+
+        total = 0.0
+        if masked_lm_labels is not None:
+            # Positions with label -1 are unmasked (ignored), the BERT
+            # convention (reference tests/unit/modeling.py MLM loss).
+            valid = (masked_lm_labels >= 0).astype(jnp.float32)
+            labels = jnp.maximum(masked_lm_labels, 0)
+            logp = jax.nn.log_softmax(prediction_logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+            total = total + jnp.sum(nll * valid) / jnp.maximum(
+                jnp.sum(valid), 1.0)
+        if next_sentence_label is not None:
+            logp = jax.nn.log_softmax(seq_relationship, axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, next_sentence_label[..., None], axis=-1)[..., 0]
+            total = total + jnp.mean(nll)
+        return total

@@ -104,17 +104,20 @@ def _dropout_fwd(x, seed, rate, bias, residual):
     return o.reshape(x.shape)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _dropout(x, seed, rate, bias, residual):
+    # seed is a (traced or concrete) int32 scalar — per-step seeds from the
+    # flax dropout RNG flow through without retracing.
     return _dropout_fwd(x, seed, rate, bias, residual)
 
 
 def _dropout_vjp_fwd(x, seed, rate, bias, residual):
-    return _dropout_fwd(x, seed, rate, bias, residual), (x, bias, residual)
+    return _dropout_fwd(x, seed, rate, bias, residual), (x, seed, bias,
+                                                         residual)
 
 
-def _dropout_vjp_bwd(seed, rate, res, g):
-    x, bias, residual = res
+def _dropout_vjp_bwd(rate, res, g):
+    x, seed, bias, residual = res
     hidden = x.shape[-1]
     n = x.size // hidden
     # Regenerate the identical mask from (seed, offset); matches what the
@@ -128,7 +131,9 @@ def _dropout_vjp_bwd(seed, rate, res, g):
     dx = dz.reshape(x.shape).astype(x.dtype)
     dbias = None if bias is None else jnp.sum(dz, axis=0).astype(bias.dtype)
     dres = None if residual is None else g.astype(residual.dtype)
-    return dx, dbias, dres
+    import numpy as _np
+    dseed = _np.zeros((), dtype=jax.dtypes.float0)  # int arg: float0 cotangent
+    return dx, dseed, dbias, dres
 
 
 _dropout.defvjp(_dropout_vjp_fwd, _dropout_vjp_bwd)
@@ -161,7 +166,7 @@ def dropout(x, rate, seed, deterministic=False):
     """Inverted dropout; mask reproducible from (seed)."""
     if deterministic or rate <= 0.0:
         return x
-    return _dropout(x, int(seed), float(rate), None, None)
+    return _dropout(x, jnp.asarray(seed, jnp.int32), float(rate), None, None)
 
 
 def fused_bias_dropout_residual(x, bias, residual, rate, seed,
@@ -176,4 +181,4 @@ def fused_bias_dropout_residual(x, bias, residual, rate, seed,
         if residual is not None:
             y = y + residual.astype(jnp.float32)
         return y.astype(x.dtype)
-    return _dropout(x, int(seed), float(rate), bias, residual)
+    return _dropout(x, jnp.asarray(seed, jnp.int32), float(rate), bias, residual)

@@ -158,7 +158,15 @@ class DeepSpeedTransformerLayer(nn.Module):
         dt = cfg.dtype
         x = hidden_states.astype(dt)
         eps = cfg.layer_norm_eps
-        seed = cfg.seed if cfg.seed > 0 else 42
+        # Dropout streams: when training under flax RNG plumbing, fold the
+        # per-step dropout key into the kernel seed so masks differ every
+        # step (the reference's advancing cuRAND state); otherwise fall back
+        # to the static config seed (reproducible stochastic_mode-style).
+        if not deterministic and self.has_rng("dropout"):
+            seed = jax.random.bits(self.make_rng("dropout"),
+                                   dtype=jnp.uint32).astype(jnp.int32)
+        else:
+            seed = cfg.seed if cfg.seed > 0 else 42
         # Distinct streams per dropout site, deterministic per layer+site.
         seeds = [seed + i for i in range(4)]
 

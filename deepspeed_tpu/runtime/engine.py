@@ -161,6 +161,11 @@ class DeepSpeedEngine(object):
 
         self._configure_checkpointing()
 
+        # TensorBoard monitor (reference engine.py:149-150).
+        self._summary_writer = None
+        self._last_loss = None
+        self.warn_tensorboard = True
+
         # Jitted program caches, keyed by static call signature.
         self._fwd_bwd_cache = {}
         self._update_fn = None
@@ -309,6 +314,56 @@ class DeepSpeedEngine(object):
 
     def tensorboard_job_name(self):
         return self._config.tensorboard_job_name
+
+    def get_summary_writer(self, name="DeepSpeedJobName", base=None):
+        """Lazy SummaryWriter (reference engine.py:247-272): events under
+        <output_path>/<job_name> or $DLWS/DLTS job dirs."""
+        if self._summary_writer is not None:
+            return self._summary_writer
+        from torch.utils.tensorboard import SummaryWriter
+        if self.tensorboard_output_path():
+            base_dir = self.tensorboard_output_path()
+            name = self.tensorboard_job_name() or name
+            log_dir = os.path.join(base_dir, name)
+        else:
+            summary_writer_dir_name = (self.tensorboard_job_name() or name)
+            if base is None:
+                base = os.path.join(os.path.expanduser("~"), "tensorboard")
+            if "DLWS_JOB_ID" in os.environ:
+                infra_job_id = os.environ["DLWS_JOB_ID"]
+            elif "DLTS_JOB_ID" in os.environ:
+                infra_job_id = os.environ["DLTS_JOB_ID"]
+            else:
+                infra_job_id = "unknown-job-id"
+            log_dir = os.path.join(base, infra_job_id, summary_writer_dir_name)
+        os.makedirs(log_dir, exist_ok=True)
+        self._summary_writer = SummaryWriter(log_dir=log_dir)
+        return self._summary_writer
+
+    def _tensorboard_step_events(self):
+        """Per-step scalars (reference engine.py:1011-1025: Train/Samples/
+        train_loss, lr, loss_scale at each boundary step)."""
+        if not self.tensorboard_enabled() or self.global_rank != 0:
+            return
+        try:
+            writer = self.get_summary_writer()
+        except Exception as e:  # tensorboard missing/unwritable: warn once
+            if self.warn_tensorboard:
+                logger.warning("tensorboard disabled: %s", e)
+                self.warn_tensorboard = False
+            return
+        if self._last_loss is not None:
+            writer.add_scalar("Train/Samples/train_loss",
+                              float(jax.device_get(self._last_loss)),
+                              self.global_samples)
+        if self.optimizer is not None:
+            writer.add_scalar("Train/Samples/lr", self.get_lr()[0],
+                              self.global_samples)
+        if self.loss_scaler is not None:
+            writer.add_scalar("Train/Samples/loss_scale",
+                              self.loss_scaler.loss_scale,
+                              self.global_samples)
+        writer.flush()
 
     def pld_enabled(self):
         return self._config.pld_enabled
@@ -566,6 +621,16 @@ class DeepSpeedEngine(object):
         module = self.module
         cast = self._cast_to_compute
         apply_fn = module.apply if hasattr(module, "apply") else module
+        # Training must actually enable dropout: flax modules gate it on a
+        # `deterministic` kwarg defaulting True, so pass False when the model
+        # accepts it and the caller didn't choose explicitly.
+        accepts_deterministic = False
+        try:
+            import inspect
+            accepts_deterministic = "deterministic" in \
+                inspect.signature(type(module).__call__).parameters
+        except (TypeError, ValueError):
+            pass
 
         def loss_and_grads(params, args, traced_kwargs, rng, scale):
             def loss_fn(p):
@@ -574,6 +639,8 @@ class DeepSpeedEngine(object):
                 call_kwargs = dict(static_kwargs)
                 call_kwargs.update(traced_kwargs)
                 if train:
+                    if accepts_deterministic:
+                        call_kwargs.setdefault("deterministic", False)
                     out = apply_fn(variables, *args,
                                    rngs={"dropout": rng}, **call_kwargs)
                 else:
@@ -691,6 +758,7 @@ class DeepSpeedEngine(object):
         """
         assert self._cached_grads is not None, \
             "backward() called without a prior forward()"
+        self._last_loss = loss
 
         if self.wall_clock_breakdown():
             self.timers("backward_microstep").start()
@@ -796,6 +864,7 @@ class DeepSpeedEngine(object):
 
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
+        self._tensorboard_step_events()
         if hasattr(self.optimizer, "notify_step"):
             # OnebitAdam freeze bookkeeping (reference onebit_adam.py:369-372).
             # Keyed off applied updates (the jitted state['step']), not
@@ -1006,6 +1075,8 @@ class DeepSpeedEngine(object):
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
         self.micro_steps += 1
+        self._last_loss = loss
+        self._tensorboard_step_events()
         if hasattr(self.optimizer, "notify_step"):
             self.optimizer.notify_step(self.global_steps - self.skipped_steps)
         self.tput_timer.stop(True)

@@ -264,6 +264,8 @@ class PipelineEngine(DeepSpeedEngine):
                                 train=True)
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
+        self._last_loss = self.agg_loss
+        self._tensorboard_step_events()
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         if self.global_steps % self.steps_per_print() == 0:

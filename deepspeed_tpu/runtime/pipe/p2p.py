@@ -1,0 +1,92 @@
+"""Stage-adjacent p2p — API mirror of reference runtime/pipe/p2p.py:13-90.
+
+The reference emulates point-to-point sends with dist.broadcast inside
+2-rank NCCL groups. Under single-controller JAX, adjacent-stage transfers are
+realized by the compiler: the PipelineEngine places each stage's arrays on
+its device set and XLA/`jax.device_put` moves activations between them (over
+ICI on hardware). This module keeps the reference's call surface —
+``init_process_groups(grid)``, ``send``/``recv``, ``barrier`` with the same
+adjacency validation — implemented as explicit device transfers, so code
+written against the reference API ports unchanged and multi-controller
+backends can swap the transport later.
+"""
+
+import jax
+
+_grid = None
+_stage_devices = None
+# In single-controller mode there is no wire: send() stages the (moved)
+# array here and recv() picks it up. Keyed by (src_stage, dest_stage).
+_mailbox = {}
+
+
+def init_process_groups(grid, stage_devices=None):
+    """Register the pipeline grid (reference p2p.py:13-19).
+
+    stage_devices: optional list mapping stage_id -> jax.Device (or device
+    list); defaults to splitting jax.devices() evenly across stages.
+    """
+    global _grid, _stage_devices
+    _grid = grid
+    assert _grid.pipe_parallel_size > 1, "There is no pipeline parallelism"
+    if stage_devices is None:
+        devs = jax.devices()
+        per = max(len(devs) // _grid.pipe_parallel_size, 1)
+        stage_devices = [devs[min(i * per, len(devs) - 1)]
+                         for i in range(_grid.pipe_parallel_size)]
+    _stage_devices = stage_devices
+    _mailbox.clear()
+
+
+def _is_valid_send_recv(src_stage, dest_stage):
+    first_stage = 0
+    last_stage = _grid.pipe_parallel_size - 1
+    assert abs(src_stage - dest_stage) == 1 or \
+        (src_stage == first_stage and dest_stage == last_stage) or \
+        (src_stage == last_stage and dest_stage == first_stage), \
+        "Functionality currently limited to send and receive between " \
+        "adjacent ranks only"
+
+
+def _device_of(stage):
+    d = _stage_devices[stage]
+    return d[0] if isinstance(d, (list, tuple)) else d
+
+
+def send(tensor, dest_stage, async_op=False):
+    """Move `tensor` to dest_stage's device and post it (reference :31-41)."""
+    src_stage = _grid.get_stage_id() if hasattr(_grid, "get_stage_id") else \
+        _grid.stage_id
+    _is_valid_send_recv(src_stage, dest_stage)
+    key = (src_stage, dest_stage)
+    assert key not in _mailbox, \
+        "send {}→{} before previous transfer was received".format(
+            src_stage, dest_stage)
+    moved = jax.device_put(tensor, _device_of(dest_stage))
+    _mailbox[key] = moved
+    return moved
+
+
+def recv(tensor, src_stage, async_op=False):
+    """Collect the posted array from src_stage (reference :44-56). `tensor`
+    is the preallocated buffer in the reference's API; here it supplies
+    shape/dtype validation only."""
+    dest_stage = _grid.get_stage_id() if hasattr(_grid, "get_stage_id") else \
+        _grid.stage_id
+    _is_valid_send_recv(src_stage, dest_stage)
+    key = (src_stage, dest_stage)
+    if key not in _mailbox:
+        raise RuntimeError(
+            "recv from stage {} before matching send".format(src_stage))
+    out = _mailbox.pop(key)
+    if tensor is not None and hasattr(tensor, "shape") and \
+            tuple(tensor.shape) != tuple(out.shape):
+        raise ValueError("recv buffer shape {} != sent shape {}".format(
+            tuple(tensor.shape), tuple(out.shape)))
+    return out
+
+
+def barrier(stage_id):
+    """Device-level sync (reference :59-67 uses a group barrier)."""
+    for v in _mailbox.values():
+        jax.block_until_ready(v)

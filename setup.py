@@ -38,8 +38,8 @@ def git_info():
         run("git rev-parse --abbrev-ref HEAD")
 
 
-if os.environ.get("DS_BUILD_OPS", "0") == "1" or any(
-        k.startswith("DS_BUILD_") for k in os.environ):
+if any(k.startswith("DS_BUILD_") and v == "1"
+       for k, v in os.environ.items()):
     try:
         build_ops_eagerly()
     except Exception as e:  # keep installs working without a toolchain
@@ -51,7 +51,7 @@ import re
 
 with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "deepspeed_tpu", "version.py")) as f:
-    match = re.search(r'^    version = "([^"]+)"$', f.read(), re.M)
+    match = re.search(r'^\s*version = "([^"]+)"\s*$', f.read(), re.M)
 if match is None:
     raise RuntimeError("could not parse version from deepspeed_tpu/version.py")
 version = match.group(1)
@@ -77,7 +77,9 @@ try:
         "DeepSpeed capability surface (JAX/XLA/pjit/Pallas)",
         packages=find_packages(include=["deepspeed_tpu", "deepspeed_tpu.*"]),
         include_package_data=True,
-        package_data={"deepspeed_tpu": ["csrc/**/*.cpp", "csrc/**/*.h"]},
+        # Explicit one-level globs: recursive '**' needs setuptools>=62.3.
+        package_data={"deepspeed_tpu": ["csrc/*/*.cpp", "csrc/*/*.h",
+                                        "csrc/*.cpp", "csrc/*.h"]},
         install_requires=["jax", "flax", "numpy"],
         extras_require={"dev": ["pytest"]},
         scripts=["bin/deepspeed", "bin/ds_report", "bin/ds_elastic"],
