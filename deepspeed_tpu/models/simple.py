@@ -72,3 +72,23 @@ def ce_loss(logits, labels):
     """Cross-entropy on integer labels (pipeline loss_fn fixture)."""
     logp = nn.log_softmax(logits)
     return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
+
+
+class PLD_SimpleModel(nn.Module):
+    """SimpleModel accepting the engine-injected PLD kwargs
+    (reference simple_model.py:135-143): `progressive_layer_drop` (bool) and
+    `pld_theta` (float) arrive at forward when PLD is enabled."""
+
+    hidden_dim: int
+
+    @nn.compact
+    def __call__(self, x, y, progressive_layer_drop=False, pld_theta=1.0,
+                 deterministic=True):
+        h = nn.Dense(self.hidden_dim, name="linear")(x)
+        if progressive_layer_drop:
+            # Keep-probability theta scales the layer output (the PLD paper's
+            # expected-depth trick in its deterministic form).
+            h = h * pld_theta
+        logp = nn.log_softmax(h)
+        nll = -jnp.take_along_axis(logp, y[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)

@@ -1,0 +1,64 @@
+"""Argparse integration (mirror reference tests/unit/test_ds_arguments.py:
+the --deepspeed/--deepspeed_config group plus user arguments)."""
+
+import argparse
+
+import pytest
+
+import deepspeed_tpu as deepspeed
+
+
+def basic_parser():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_epochs", type=int)
+    return parser
+
+
+def test_no_ds_arguments():
+    parser = basic_parser()
+    args = parser.parse_args(["--num_epochs", "2"])
+    assert args.num_epochs == 2
+    assert not hasattr(args, "deepspeed")
+
+
+def test_ds_arguments_added():
+    parser = deepspeed.add_config_arguments(basic_parser())
+    args = parser.parse_args(["--num_epochs", "2"])
+    assert args.num_epochs == 2
+    assert args.deepspeed is False
+    assert args.deepspeed_config is None
+
+
+def test_ds_enable_argument():
+    parser = deepspeed.add_config_arguments(basic_parser())
+    args = parser.parse_args(["--num_epochs", "2", "--deepspeed"])
+    assert args.deepspeed is True
+
+
+def test_ds_config_argument():
+    parser = deepspeed.add_config_arguments(basic_parser())
+    args = parser.parse_args(
+        ["--num_epochs", "2", "--deepspeed", "--deepspeed_config",
+         "foo.json"])
+    assert args.deepspeed_config == "foo.json"
+
+
+def test_core_deepscale_arguments():
+    """Deprecated --deepscale spelling still parses (reference :80-106)."""
+    parser = deepspeed.add_config_arguments(basic_parser())
+    args = parser.parse_args(
+        ["--deepscale", "--deepscale_config", "bar.json"])
+    assert args.deepscale is True
+    assert args.deepscale_config == "bar.json"
+
+
+def test_mutually_defined_config_rejected():
+    """Engine rejects both --deepspeed_config and config_params
+    (reference engine.py:460-474 sanity check)."""
+    from deepspeed_tpu.models.simple import SimpleModel
+    parser = deepspeed.add_config_arguments(basic_parser())
+    args = parser.parse_args(["--deepspeed_config", "nonexistent.json"])
+    with pytest.raises(Exception):
+        deepspeed.initialize(args=args,
+                             model=SimpleModel(hidden_dim=4),
+                             config_params={"train_batch_size": 8})

@@ -1,0 +1,94 @@
+"""Dynamic loss scaling behavior under the engine (mirror reference
+tests/unit/test_dynamic_loss_scale.py: no-overflow growth every scale_window,
+all-overflow halving to min, mixed recovery)."""
+
+import numpy as np
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.models.simple import SimpleModel
+
+
+def _engine(scale_power=8, window=2, hysteresis=1):
+    engine, _, _, _ = deepspeed.initialize(
+        model=SimpleModel(hidden_dim=8),
+        config_params={
+            "train_batch_size": 8,
+            "steps_per_print": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.00015}},
+            "fp16": {"enabled": True, "loss_scale": 0,
+                     "initial_scale_power": scale_power,
+                     "loss_scale_window": window,
+                     "hysteresis": hysteresis},
+        })
+    return engine
+
+
+def _step(engine, magnitude=0.1, seed=0):
+    rng = np.random.RandomState(seed)
+    x = (rng.randn(8, 8) * magnitude).astype(np.float32)
+    y = rng.randint(0, 8, size=(8,))
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
+
+
+def test_no_overflow_scale_grows():
+    engine = _engine(scale_power=8, window=2)
+    expected = 2.0 ** 8
+    assert engine.loss_scaler.cur_scale == expected
+    for i in range(6):
+        _step(engine, 0.1, seed=i)
+        assert engine.loss_scaler.cur_iter == i + 1
+        if (i + 1) % 2 == 0:
+            expected *= 2
+        assert engine.loss_scaler.cur_scale == expected
+    assert engine.skipped_steps == 0
+
+
+def test_all_overflow_scale_halves():
+    engine = _engine(scale_power=4, window=2)
+    expected = 2.0 ** 4
+    for i in range(4):
+        _step(engine, 1e30, seed=i)  # guaranteed non-finite grads
+        expected = max(expected / 2, 1)
+        assert engine.loss_scaler.cur_scale == expected
+        assert engine.skipped_steps == i + 1
+    # optimizer state untouched by skipped steps
+    assert int(engine.opt_state["step"]) == 0
+
+
+def test_some_overflow_recovery():
+    engine = _engine(scale_power=8, window=2)
+    scale0 = engine.loss_scaler.cur_scale
+    _step(engine, 1e30, seed=0)           # overflow: halve
+    assert engine.loss_scaler.cur_scale == scale0 / 2
+    assert engine.skipped_steps == 1
+    expected = scale0 / 2
+    for i in range(2):                    # window clean steps: double
+        _step(engine, 0.1, seed=i + 1)
+    assert engine.loss_scaler.cur_scale == expected * 2
+    assert engine.skipped_steps == 1
+    assert int(engine.opt_state["step"]) == 2
+
+
+def test_hysteresis_delays_halving():
+    engine = _engine(scale_power=8, window=100, hysteresis=2)
+    scale0 = engine.loss_scaler.cur_scale
+    _step(engine, 1e30, seed=0)           # first overflow eats hysteresis
+    assert engine.loss_scaler.cur_scale == scale0
+    _step(engine, 1e30, seed=1)           # second overflow halves
+    assert engine.loss_scaler.cur_scale == scale0 / 2
+
+
+def test_static_loss_scale():
+    engine, _, _, _ = deepspeed.initialize(
+        model=SimpleModel(hidden_dim=8),
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.00015}},
+            "fp16": {"enabled": True, "loss_scale": 128.0},
+        })
+    assert engine.loss_scaler.loss_scale == 128.0
+    for i in range(3):
+        _step(engine, 0.1, seed=i)
+    assert engine.loss_scaler.loss_scale == 128.0

@@ -1,0 +1,68 @@
+"""Progressive Layer Drop tests (mirror reference tests/unit/test_pld.py:
+schedule math, PLD kwargs injection into forward, non-PLD model unaffected).
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.models.simple import PLD_SimpleModel, SimpleModel
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+
+@pytest.mark.parametrize("theta", [0, 0.1, 0.9, 1.0])
+def test_pld_schedule(theta):
+    gamma = 0.001
+    pld_scheduler = ProgressiveLayerDrop(theta, gamma)
+    for i in range(10):
+        pld_scheduler.update_state(i)
+        expected_theta = (1. - theta) * np.exp(-gamma * i) + theta
+        actual_theta = pld_scheduler.get_theta()
+        assert abs(expected_theta - actual_theta) < 1e-12
+
+
+@pytest.mark.parametrize("theta", [0.1, 1.0])
+def test_pld_model(theta):
+    gamma = 0.001
+    engine, _, _, _ = deepspeed.initialize(
+        model=PLD_SimpleModel(hidden_dim=8),
+        config_params={
+            "train_batch_size": 8,
+            "steps_per_print": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.0001}},
+            "progressive_layer_drop": {"enabled": True, "theta": theta,
+                                       "gamma": gamma},
+        })
+    assert engine.pld_enabled()
+    assert engine.progressive_layer_drop is not None
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8).astype(np.float32)
+    y = rng.randint(0, 8, size=(8,))
+    for i in range(5):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        expected_theta = (1. - theta) * np.exp(-gamma * i) + theta
+        assert abs(engine.progressive_layer_drop.get_theta() -
+                   expected_theta) < 1e-12
+        assert np.isfinite(float(loss))
+
+
+def test_non_pld_model():
+    """A model without PLD kwargs trains fine when PLD is disabled
+    (reference :75-103)."""
+    engine, _, _, _ = deepspeed.initialize(
+        model=SimpleModel(hidden_dim=8),
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.0001}},
+            "progressive_layer_drop": {"enabled": False},
+        })
+    assert not engine.pld_enabled()
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8).astype(np.float32)
+    y = rng.randint(0, 8, size=(8,))
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
+    assert np.isfinite(float(loss))
