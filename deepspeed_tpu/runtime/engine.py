@@ -192,6 +192,15 @@ class DeepSpeedEngine(object):
         config_file = getattr(args, "deepspeed_config", None) if args else None
         assert config_file is not None or config_params is not None, \
             "DeepSpeed requires --deepspeed_config to specify configuration file"
+        if config_file is not None and config_params is not None:
+            # Mirrors the reference sanity check (engine.py:460-474): the two
+            # config sources are mutually exclusive.
+            raise ValueError(
+                "Not sure how to proceed, we were given both a deepspeed_config "
+                "file and a config_params dict — pass exactly one")
+        if config_file is not None and not os.path.isfile(config_file):
+            raise FileNotFoundError(
+                "DeepSpeed config file not found: {}".format(config_file))
         return DeepSpeedConfig(config_file,
                                mpu=self.mpu,
                                param_dict=config_params,
