@@ -175,6 +175,7 @@ class DeepSpeedEngine(object):
 
         # ZeRO sharding policy (applied when params exist).
         self._shardings_ready = False
+        self._grad_constraint = None
         if self.params is not None:
             self._setup_shardings()
 
@@ -558,6 +559,12 @@ class DeepSpeedEngine(object):
             # Place state according to policy now (one-time reshard).
             self.opt_state = jax.device_put(self.opt_state, moment_sh)
         self.params = jax.device_put(self.params, self.param_sharding)
+        # ZeRO-2/3 semantics (reference stage2.py:675-738): gradients are
+        # REDUCE-SCATTERED to their owner shard, never materialized
+        # replicated. Enforced as a GSPMD constraint inside every grad-
+        # producing program; XLA lowers the cross-replica sum to
+        # reduce-scatter instead of all-reduce.
+        self._grad_constraint = self.grad_sharding if stage >= 2 else None
         self._shardings_ready = True
 
     # ------------------------------------------------------------------- RNG
@@ -623,9 +630,11 @@ class DeepSpeedEngine(object):
 
     def _get_fwd_bwd(self, n_args, static_kwargs, traced_keys, train):
         key = (n_args, tuple(sorted(static_kwargs.items())),
-               tuple(sorted(traced_keys)), train, self.compute_dtype.__name__)
+               tuple(sorted(traced_keys)), train, self.compute_dtype.__name__,
+               self._grad_constraint is not None)
         if key in self._fwd_bwd_cache:
             return self._fwd_bwd_cache[key]
+        grad_constraint = self._grad_constraint
 
         module = self.module
         cast = self._cast_to_compute
@@ -658,6 +667,8 @@ class DeepSpeedEngine(object):
                 return loss * scale, out
 
             (_, out), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if grad_constraint is not None:
+                grads = jax.lax.with_sharding_constraint(grads, grad_constraint)
             return out, grads
 
         jitted = jax.jit(loss_and_grads)
@@ -1050,6 +1061,7 @@ class DeepSpeedEngine(object):
             cast = self._cast_to_compute
             clip = self.gradient_clipping()
             optimizer = self.optimizer
+            grad_constraint = self._grad_constraint
 
             def fused(params, opt_state, args, rng, lr, beta1, beta2):
                 def loss_fn(p):
@@ -1058,6 +1070,9 @@ class DeepSpeedEngine(object):
                                         rngs={"dropout": rng})
 
                 loss, grads = jax.value_and_grad(loss_fn)(params)
+                if grad_constraint is not None:
+                    grads = jax.lax.with_sharding_constraint(
+                        grads, grad_constraint)
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(jnp.float32), grads)
                 if clip > 0.0:

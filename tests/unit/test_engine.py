@@ -2,6 +2,7 @@
 loop (`loss = engine(x, y); engine.backward(loss); engine.step()`) against
 SimpleModel, mirroring reference tests/unit/test_fp16.py / test_zero.py basics."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -133,6 +134,79 @@ def test_zero_stages_loss_parity(eight_devices):
     for stage in [1, 2, 3]:
         np.testing.assert_allclose(losses_by_stage[stage],
                                    losses_by_stage[0], rtol=2e-2)
+
+
+def _leaf_shard_fraction(arr):
+    """Per-device shard elements / global elements for a jax.Array."""
+    shard = arr.addressable_shards[0].data
+    return shard.size / arr.size
+
+
+def test_zero_gradient_and_state_partitioning(eight_devices):
+    """ZeRO-2/3 must actually SHARD, not just document sharding: per-device
+    gradient shards are 1/N-sized at stage>=2 (reference reduce-scatter
+    semantics, stage2.py:675-738), optimizer moments 1/N at stage>=1, params
+    1/N at stage 3. Verified via addressable_shards, not loss values."""
+    n = len(eight_devices)
+    for stage in [0, 1, 2, 3]:
+        model = SimpleModel(hidden_dim=16)
+        cfg = base_config(bf16={"enabled": True},
+                          zero_optimization={"stage": stage})
+        engine, _, _, _ = deepspeed.initialize(model=model, config_params=cfg)
+        x, y = random_batch()
+        loss = engine(x, y)
+        engine.backward(loss)
+
+        grads = engine._grad_acc
+        grad_fracs = [_leaf_shard_fraction(g)
+                      for g in jax.tree_util.tree_leaves(grads)]
+        if stage >= 2:
+            assert all(f == pytest.approx(1.0 / n) for f in grad_fracs), \
+                "stage {}: grads not 1/{} per device: {}".format(
+                    stage, n, grad_fracs)
+        else:
+            assert all(f == pytest.approx(1.0) for f in grad_fracs)
+
+        engine.step()
+        if stage >= 1:
+            m_fracs = [_leaf_shard_fraction(g) for g in
+                       jax.tree_util.tree_leaves(engine.opt_state["exp_avg"])]
+            assert all(f == pytest.approx(1.0 / n) for f in m_fracs)
+        p_fracs = [_leaf_shard_fraction(g)
+                   for g in jax.tree_util.tree_leaves(engine.params)]
+        if stage >= 3:
+            assert all(f == pytest.approx(1.0 / n) for f in p_fracs)
+        else:
+            assert all(f == pytest.approx(1.0) for f in p_fracs)
+
+
+def test_zero2_fused_train_batch_grads_sharded(eight_devices):
+    """The fused train_batch program must carry the stage-2 grad constraint:
+    one sdy.sharding_constraint over the 'data' axis per parameter leaf in
+    the lowered module. (The compiled collective choice — reduce-scatter on
+    TPU, all-reduce+slice on the CPU simulator — is backend-dependent, so we
+    assert the constraint, not the lowering.)"""
+    model = SimpleModel(hidden_dim=16)
+    engine, _, _, _ = deepspeed.initialize(
+        model=model,
+        config_params=base_config(bf16={"enabled": True},
+                                  zero_optimization={"stage": 2}))
+    x, y = random_batch()
+    loss = engine.train_batch(batch=(x, y))
+    assert np.isfinite(float(loss))
+    (fused,) = engine._fused_step_cache.values()
+    import jax.numpy as jnp
+    lowered = fused.lower(engine.params, engine.opt_state,
+                          mesh_lib.shard_batch(engine.mesh, (jnp.asarray(x),
+                                                             jnp.asarray(y))),
+                          jax.random.PRNGKey(0), jnp.float32(1e-2),
+                          jnp.float32(0.9), jnp.float32(0.999)).as_text()
+    n_constraints = sum(1 for line in lowered.splitlines()
+                        if "sharding_constraint" in line and '"data"' in line)
+    n_leaves = len(jax.tree_util.tree_leaves(engine.params))
+    assert n_constraints >= n_leaves, \
+        "expected a grad sharding constraint per param leaf ({}), found {}" \
+        .format(n_leaves, n_constraints)
 
 
 def test_train_batch_fused_path():
