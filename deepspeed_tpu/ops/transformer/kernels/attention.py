@@ -9,6 +9,17 @@ different: one flash-style Pallas kernel keeps each score block in VMEM and
 never writes the [T, T] matrix to HBM — O(T) memory instead of O(T^2), and
 both GEMMs land on the MXU from the same kernel.
 
+Kernel structure (the part that makes it fast):
+- the key/value block loop is a GRID dimension, not a fori_loop over a
+  whole-[T, d] VMEM residency: Pallas double-buffers the per-block DMAs
+  against compute, so HBM reads overlap the MXU;
+- matmul inputs stay in the model dtype (bf16) with fp32 MXU accumulation
+  (preferred_element_type); softmax statistics and the output accumulator
+  live in fp32 VMEM scratch across grid steps;
+- causal masking skips fully-masked key blocks: their index map clamps to
+  the last useful block (no new DMA is issued for a repeated index) and
+  @pl.when skips the compute.
+
 Forward: online-softmax accumulation over key/value blocks.
 Backward: standard two-pass flash backward (one kernel produces dq looping
 over kv blocks; one produces dk/dv looping over q blocks), using the saved
@@ -26,6 +37,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+# Lane width for the fp32 softmax-statistic scratch rows: Mosaic pads
+# second-minor×minor tiles to (8, 128), so statistics are kept broadcast
+# across a full 128-lane row instead of a width-1 column.
+_STATS_LANES = 128
 
 
 def _interpret():
@@ -54,87 +69,128 @@ def mha_reference(q, k, v, mask=None, causal=False, scale=None):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _last_kv_block(iq, block_q, block_k):
+    """Index of the last key block a causal query block iq attends to."""
+    return ((iq + 1) * block_q - 1) // block_k
+
+
+def _first_q_block(jk, block_q, block_k):
+    """Index of the first query block that attends to causal key block jk."""
+    return (jk * block_k) // block_q
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(*refs, scale, causal, block_k, has_mask):
+def _fwd_kernel(*refs, scale, causal, block_q, block_k, has_mask):
     if has_mask:
-        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref = refs
+        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc, m_s, l_s = refs
     else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s = refs
         mask_ref = None
 
-    q = q_ref[0, 0].astype(jnp.float32) * scale            # [bq, d]
-    bq, d = q.shape
-    t_kv = k_ref.shape[2]
     iq = pl.program_id(2)
-    n_kv = pl.cdiv(t_kv, block_k)
+    j = pl.program_id(3)
+    n_kv = pl.num_programs(3)
 
-    def body(j, carry):
-        acc, m_prev, l_prev = carry
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+
+    if causal:
+        active = j <= _last_kv_block(iq, block_q, block_k)
+    else:
+        active = j < n_kv
+
+    @pl.when(active)
+    def _compute():
+        q = q_ref[0, 0]                                    # [bq, d] model dtype
+        k_blk = k_ref[0, 0]                                # [bk, d]
+        v_blk = v_ref[0, 0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [bq, bk]
+                                preferred_element_type=jnp.float32) * scale
         if mask_ref is not None:
-            s = s + mask_ref[0, pl.ds(j * block_k, block_k)][None, :]
+            s = s + mask_ref[0][None, :]
         if causal:
-            q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_s[:, 0:1]                               # [bq, 1]
+        l_prev = l_s[:, 0:1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        p = jnp.exp(s - m_new)                             # [bq, bk] fp32
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+        # Second MXU matmul in the model dtype with fp32 accumulation.
+        pv = jax.lax.dot_general(p.astype(v_blk.dtype), v_blk,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc[...] = acc[...] * alpha + pv
 
-    # Under a causal mask, blocks past the diagonal contribute nothing.
-    n_loop = jnp.minimum(n_kv, pl.cdiv((iq + 1) * bq, block_k)) if causal else n_kv
-    acc, m, l = jax.lax.fori_loop(
-        0, n_loop, body,
-        (jnp.zeros((bq, d), jnp.float32),
-         jnp.full((bq, 1), NEG_INF, jnp.float32),
-         jnp.zeros((bq, 1), jnp.float32)))
-
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l)
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        l = jnp.maximum(l_s[:, 0:1], 1e-30)
+        o_ref[0, 0] = (acc[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_s[:, 0:1] + jnp.log(l)
 
 
 def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k):
+    from jax.experimental.pallas import tpu as pltpu
+
     b, h, t_q, d = q.shape
     t_kv = k.shape[2]
     block_q = min(block_q, t_q)
     block_k = min(block_k, t_kv)
-    grid = (b, h, pl.cdiv(t_q, block_q))
+    n_kv = pl.cdiv(t_kv, block_k)
+    grid = (b, h, pl.cdiv(t_q, block_q), n_kv)
+
+    if causal:
+        def kv_index(b_, h_, i, j):
+            # Clamp past-diagonal blocks to the last useful one: a repeated
+            # block index issues no new DMA, and @pl.when skips the compute.
+            return (b_, h_, jnp.minimum(j, _last_kv_block(i, block_q, block_k)), 0)
+    else:
+        def kv_index(b_, h_, i, j):
+            return (b_, h_, j, 0)
 
     in_specs = [
-        pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0)),
-        pl.BlockSpec((1, 1, t_kv, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-        pl.BlockSpec((1, 1, t_kv, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+        pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, block_k, d), kv_index),
+        pl.BlockSpec((1, 1, block_k, d), kv_index),
     ]
     args = [q, k, v]
     if mask is not None:
-        in_specs.append(pl.BlockSpec((1, t_kv), lambda b_, h_, i: (b_, 0)))
+        in_specs.append(
+            pl.BlockSpec((1, block_k), lambda b_, h_, i, j: (b_, kv_index(b_, h_, i, j)[2])))
         args.append(mask.astype(jnp.float32))
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_k=block_k, has_mask=mask is not None),
+                          block_q=block_q, block_k=block_k,
+                          has_mask=mask is not None),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, t_q, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, t_q, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
         ],
         interpret=_interpret(),
     )(*args)
@@ -148,153 +204,195 @@ def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k):
 #   dS = P * (dP - delta),  dq = dS K,  dk = dS^T q,  dv = P^T dO
 # P is recomputed blockwise from q, k and the saved lse (never stored).
 
-def _bwd_dq_kernel(*refs, scale, causal, block_k, has_mask):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, has_mask):
     if has_mask:
         (q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-         dq_ref) = refs
+         dq_ref, dq_acc) = refs
     else:
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+         dq_acc) = refs
         mask_ref = None
 
-    q = q_ref[0, 0].astype(jnp.float32)                    # [bq, d]
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]                                    # [bq, 1]
-    delta = delta_ref[0, 0]
-    bq, d = q.shape
-    t_kv = k_ref.shape[2]
     iq = pl.program_id(2)
-    n_kv = pl.cdiv(t_kv, block_k)
+    j = pl.program_id(3)
+    n_kv = pl.num_programs(3)
 
-    def body(j, dq):
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    if causal:
+        active = j <= _last_kv_block(iq, block_q, block_k)
+    else:
+        active = j < n_kv
+
+    @pl.when(active)
+    def _compute():
+        q = q_ref[0, 0]                                    # [bq, d]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]                                # [bq, 1]
+        delta = delta_ref[0, 0]
+        k_blk = k_ref[0, 0]
+        v_blk = v_ref[0, 0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if mask_ref is not None:
-            s = s + mask_ref[0, pl.ds(j * block_k, block_k)][None, :]
+            s = s + mask_ref[0][None, :]
         if causal:
-            q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                               # [bq, bk]
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+        p = jnp.exp(s - lse)                               # [bq, bk] fp32
+        dp = jax.lax.dot_general(do.astype(v_blk.dtype), v_blk,
+                                 (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(k_blk.dtype)
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    n_loop = jnp.minimum(n_kv, pl.cdiv((iq + 1) * bq, block_k)) if causal else n_kv
-    dq = jax.lax.fori_loop(0, n_loop, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, scale, causal, block_q, has_mask):
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, has_mask):
     if has_mask:
         (q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref) = refs
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
     else:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-         dv_ref) = refs
+         dv_ref, dk_acc, dv_acc) = refs
         mask_ref = None
 
-    k_blk = k_ref[0, 0].astype(jnp.float32)                # [bk, d]
-    v_blk = v_ref[0, 0].astype(jnp.float32)
-    bk, d = k_blk.shape
-    t_q = q_ref.shape[2]
     jk = pl.program_id(2)
-    n_q = pl.cdiv(t_q, block_q)
-    if mask_ref is not None:
-        mask_blk = mask_ref[0][None, :]                    # [1, bk]
-    else:
-        mask_blk = None
+    i = pl.program_id(3)
+    n_q = pl.num_programs(3)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if mask_blk is not None:
-            s = s + mask_blk
-        if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
-            k_pos = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                               # [bq, bk]
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
     if causal:
-        # Query blocks strictly above this kv block's diagonal are masked out.
-        start = (jk * bk) // block_q
+        active = i >= _first_q_block(jk, block_q, block_k)
     else:
-        start = 0
-    dk, dv = jax.lax.fori_loop(
-        start, n_q, body,
-        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+        active = i < n_q
+
+    @pl.when(active)
+    def _compute():
+        k_blk = k_ref[0, 0]                                # [bk, d]
+        v_blk = v_ref[0, 0]
+        q = q_ref[0, 0]                                    # [bq, d]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]                                # [bq, 1]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if mask_ref is not None:
+            s = s + mask_ref[0][None, :]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = jk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                               # [bq, bk] fp32
+        p_cast = p.astype(do.dtype)
+        dv_acc[...] += jax.lax.dot_general(
+            p_cast, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do.astype(v_blk.dtype), v_blk,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == n_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(res, g, scale, causal, block_q, block_k):
+    from jax.experimental.pallas import tpu as pltpu
+
     q, k, v, mask, o, lse = res
     b, h, t_q, d = q.shape
     t_kv = k.shape[2]
     block_q = min(block_q, t_q)
     block_k = min(block_k, t_kv)
+    n_q = pl.cdiv(t_q, block_q)
+    n_kv = pl.cdiv(t_kv, block_k)
     do = g
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
-    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0))
-    q_full = pl.BlockSpec((1, 1, t_q, d), lambda b_, h_, j: (b_, h_, 0, 0))
-    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j: (b_, h_, j, 0))
-    kv_full = pl.BlockSpec((1, 1, t_kv, d), lambda b_, h_, i: (b_, h_, 0, 0))
-    row_blk = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i: (b_, h_, i, 0))
-    row_full = pl.BlockSpec((1, 1, t_q, 1), lambda b_, h_, j: (b_, h_, 0, 0))
+    # dq: grid over (q block, kv block), kv innermost and pipelined.
+    if causal:
+        def kv_index(b_, h_, i, j):
+            return (b_, h_, jnp.minimum(j, _last_kv_block(i, block_q, block_k)), 0)
+    else:
+        def kv_index(b_, h_, i, j):
+            return (b_, h_, j, 0)
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d), kv_index)
+    row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
 
-    # dq: grid over q blocks.
-    in_specs = [q_spec, kv_full, kv_full]
+    in_specs = [q_spec, kv_spec, kv_spec]
     args = [q, k, v]
     if mask is not None:
-        in_specs.append(pl.BlockSpec((1, t_kv), lambda b_, h_, i: (b_, 0)))
+        in_specs.append(
+            pl.BlockSpec((1, block_k), lambda b_, h_, i, j: (b_, kv_index(b_, h_, i, j)[2])))
         args.append(mask.astype(jnp.float32))
-    in_specs += [q_spec, row_blk, row_blk]
+    in_specs += [q_spec, row_spec, row_spec]
     args += [do, lse, delta]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k, has_mask=mask is not None),
-        grid=(b, h, pl.cdiv(t_q, block_q)),
+                          block_q=block_q, block_k=block_k,
+                          has_mask=mask is not None),
+        grid=(b, h, n_q, n_kv),
         in_specs=in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(*args)
 
-    # dk/dv: grid over kv blocks.
-    in_specs = [q_full, kv_spec, kv_spec]
+    # dk/dv: grid over (kv block, q block), q innermost and pipelined.
+    if causal:
+        def q_index(b_, h_, jk, i):
+            return (b_, h_, jnp.maximum(i, _first_q_block(jk, block_q, block_k)), 0)
+    else:
+        def q_index(b_, h_, jk, i):
+            return (b_, h_, i, 0)
+    q_spec2 = pl.BlockSpec((1, 1, block_q, d), q_index)
+    kv_spec2 = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, jk, i: (b_, h_, jk, 0))
+    row_spec2 = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b_, h_, jk, i: (b_, h_, q_index(b_, h_, jk, i)[2], 0))
+
+    in_specs = [q_spec2, kv_spec2, kv_spec2]
     args = [q, k, v]
     if mask is not None:
-        in_specs.append(pl.BlockSpec((1, block_k), lambda b_, h_, j: (b_, j)))
+        in_specs.append(pl.BlockSpec((1, block_k), lambda b_, h_, jk, i: (b_, jk)))
         args.append(mask.astype(jnp.float32))
-    in_specs += [q_full, row_full, row_full]
+    in_specs += [q_spec2, row_spec2, row_spec2]
     args += [do, lse, delta]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, has_mask=mask is not None),
-        grid=(b, h, pl.cdiv(t_kv, block_k)),
+                          block_q=block_q, block_k=block_k,
+                          has_mask=mask is not None),
+        grid=(b, h, n_kv, n_q),
         in_specs=in_specs,
-        out_specs=[kv_spec, kv_spec],
+        out_specs=[kv_spec2, kv_spec2],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=_interpret(),
     )(*args)
 
@@ -325,7 +423,7 @@ _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
 def flash_attention(q, k, v, mask=None, causal=False, scale=None,
-                    block_q=128, block_k=128):
+                    block_q=1024, block_k=1024):
     """Fused (flash) multi-head attention.
 
     Args:
@@ -335,6 +433,9 @@ def flash_attention(q, k, v, mask=None, causal=False, scale=None,
         convention (csrc/transformer/softmax_kernels.cu attn_softmax).
       causal: apply a causal (autoregressive) mask.
       scale: score scale; default 1/sqrt(D).
+      block_q, block_k: VMEM tile sizes. Defaults tuned on v5e (GPT-2 355M
+        shapes, d=64): 1024x1024 beats dense XLA attention 2.1x at T=1024
+        fwd+bwd and 3.0x at T=2048.
     Returns: [B, H, T, D] in q.dtype.
     """
     d = q.shape[-1]
