@@ -28,15 +28,18 @@ import pickle
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.parallel import mesh as mesh_lib
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.runtime.pipe import p2p
 from deepspeed_tpu.runtime.pipe import schedule as p_schedule
 from deepspeed_tpu.runtime.pipe.module import (
     LayerSpec,
     PipelineModule,
     TiedLayerSpec,
 )
-from deepspeed_tpu.runtime.utils import clip_grad_norm_, ensure_directory_exists
+from deepspeed_tpu.runtime.utils import ensure_directory_exists
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 def _is_flax_module(layer):
@@ -50,20 +53,27 @@ class PipelineEngine(DeepSpeedEngine):
         model = kwargs.get("model", args[1] if len(args) > 1 else None)
         assert isinstance(model, PipelineModule), \
             "model must be a PipelineModule"
-        # Build a pipe-axis mesh before the config's batch-triangle math runs:
-        # the executor is dp=1 within stages this round, so the config's world
-        # size (= data-parallel size) must be 1 regardless of device count.
+        # Build a pipe-axis mesh before the config's batch-triangle math runs,
+        # and work out the PP x DP grid: each pipeline stage owns a
+        # ('data','model') submesh and shards its micro-batch over 'data', so
+        # the config's world size (= data-parallel size) is devices-per-stage
+        # (reference PipelineParallelGrid semantics, pipe/topology.py:246-455).
         if kwargs.get("mesh") is None:
             from deepspeed_tpu.parallel.mesh import build_mesh
             devices = jax.devices()
             pp = model.num_stages if len(devices) % model.num_stages == 0 \
                 and len(devices) >= model.num_stages else 1
-            # All devices go into the mesh (n//pp per stage) so no chip is
-            # silently dropped; the dp-within-stage dimension is represented
-            # on the 'data' axis even though this executor currently places
-            # work on the first device of each stage group.
             kwargs["mesh"] = build_mesh(num_dp=len(devices) // pp, num_mp=1,
                                         num_pp=pp, devices=devices)
+        _mesh = kwargs["mesh"]
+        _n = _mesh.devices.size
+        _mp = _mesh.shape.get(mesh_lib.MODEL_AXIS, 1)
+        if _n % model.num_stages == 0 and _n >= model.num_stages:
+            self._pipe_dp = (_n // model.num_stages) // _mp
+        else:
+            # Fewer devices than stages (round-robin placement): no
+            # data-parallel replication within stages.
+            self._pipe_dp = 1
         super().__init__(*args, **kwargs)
         assert not self.elasticity_enabled(), \
             "Elasticity is not currently supported with pipeline parallelism."
@@ -75,6 +85,7 @@ class PipelineEngine(DeepSpeedEngine):
         # Per-stage device assignment: slice the global mesh's 'pipe' axis;
         # if the mesh has no pipe axis (or wrong size), split devices evenly.
         self.stage_devices = self._assign_stage_devices()
+        self.stage_meshes = self._build_stage_meshes()
 
         # Materialized state (lazy, from first batch shapes):
         self.layers = [self.pipe_module.build_layer(i)
@@ -89,9 +100,9 @@ class PipelineEngine(DeepSpeedEngine):
         self.agg_loss = None
 
     def _config_world_size(self):
-        # Executor is dp=1 within stages this round: batch math must not
-        # multiply by the mesh 'data' dim.
-        return 1
+        # Data-parallel size WITHIN each stage: micro-batches are sharded over
+        # the stage submesh's 'data' axis, so batch math multiplies by it.
+        return getattr(self, "_pipe_dp", 1)
 
     # ------------------------------------------------------------- placement
 
@@ -105,12 +116,48 @@ class PipelineEngine(DeepSpeedEngine):
         # Fewer devices than stages: round-robin.
         return [[devices[s % n]] for s in range(self.num_stages)]
 
+    def _build_stage_meshes(self):
+        """One ('data','model') Mesh per stage over that stage's devices —
+        the single-controller analogue of the reference's per-stage dp/slice
+        process groups (pipe/topology.py:252-455)."""
+        mp = self.mp_world_size
+        meshes = []
+        for devs in self.stage_devices:
+            if len(devs) % mp == 0 and len(devs) >= mp:
+                dp, mp_local = len(devs) // mp, mp
+            else:
+                # Stage device count not a multiple of the model axis (e.g.
+                # round-robin placement with fewer devices than stages):
+                # fall back to pure-dp within the stage rather than crash or
+                # drop chips.
+                dp, mp_local = len(devs), 1
+            arr = np.asarray(devs).reshape(dp, mp_local)
+            meshes.append(Mesh(arr, (mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS)))
+        return meshes
+
     def _stage_of_layer(self, idx):
         return self.pipe_module.stage_owner(idx)
 
     def _place(self, tree, stage_id):
-        dev = self.stage_devices[stage_id][0]
-        return jax.device_put(tree, dev)
+        """Replicate a pytree (params, opt state) over a stage's submesh."""
+        sh = NamedSharding(self.stage_meshes[stage_id], P())
+        return jax.device_put(tree, sh)
+
+    def _place_batch(self, tree, stage_id):
+        """Shard batch-leading arrays over the stage's 'data' axis; leaves
+        whose leading dim does not divide stay replicated."""
+        mesh = self.stage_meshes[stage_id]
+        dp = mesh.shape.get(mesh_lib.DATA_AXIS, 1)
+        batch_sh = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+        rep = NamedSharding(mesh, P())
+
+        def _put(x):
+            if dp > 1 and hasattr(x, "shape") and len(x.shape) > 0 \
+                    and x.shape[0] % dp == 0:
+                return jax.device_put(x, batch_sh)
+            return jax.device_put(x, rep)
+
+        return jax.tree_util.tree_map(_put, tree)
 
     # --------------------------------------------------------- materialization
 
@@ -121,7 +168,7 @@ class PipelineEngine(DeepSpeedEngine):
         x = jnp.asarray(x)
         rng = self._next_rng()
         for idx, layer in enumerate(self.layers):
-            x = self._place(x, self._stage_of_layer(idx))
+            x = self._place_batch(x, self._stage_of_layer(idx))
             spec = self.pipe_module.layer_specs[idx]
             tied_key = spec.key if isinstance(spec, TiedLayerSpec) else None
             if tied_key is not None and tied_key in self.tied_param_owner:
@@ -314,8 +361,8 @@ class PipelineEngine(DeepSpeedEngine):
                  "in_grad": {}, "out_grad": {}}
                 for _ in range(S)
             ],
-            # mailboxes: (src_stage, dst_stage) -> list of payloads (FIFO)
-            "mail": {},
+            # the p2p transport: FIFO (src_stage, dst_stage) payload queues
+            "mail": p2p.Mailbox(),
             "data_iter": data_iter,
             "losses": [],
             "train": train,
@@ -339,7 +386,7 @@ class PipelineEngine(DeepSpeedEngine):
                                             p_schedule.RecvGrad)):
                             src = s + 1 if isinstance(
                                 cmd, p_schedule.RecvGrad) else s - 1
-                            if not state["mail"].get((src, s)):
+                            if not state["mail"].has(src, s):
                                 break  # blocked; try other stages first
                         self._dispatch(cmd, s, state)
                         queues[s].pop(0)
@@ -386,10 +433,10 @@ class PipelineEngine(DeepSpeedEngine):
         state["mb_next"][endpoint] += 1
         batch = self._load_micro_batch(state, mb_idx)
         if stage_id == 0:
-            buf["inputs"][cmd.buffer_id] = self._place(
+            buf["inputs"][cmd.buffer_id] = self._place_batch(
                 jnp.asarray(batch[0]), stage_id)
         if stage_id == self.num_stages - 1:
-            buf["labels"][cmd.buffer_id] = self._place(
+            buf["labels"][cmd.buffer_id] = self._place_batch(
                 jnp.asarray(batch[1]), stage_id)
 
     def _exec_forward_pass(self, cmd, stage_id, state):
@@ -427,6 +474,12 @@ class PipelineEngine(DeepSpeedEngine):
             seed = jnp.ones_like(buf["outputs"][cmd.buffer_id])
             # scale for mean over micro-batches (reference divides loss by gas)
             seed = seed / self.micro_batches
+            if self.loss_scaler is not None:
+                # fp16 loss scaling rides the backward seed; grads are
+                # unscaled (or the step skipped) at OptimizerStep, matching
+                # the reference fp16 step path the pipeline engine inherits.
+                seed = seed * jnp.asarray(self.loss_scaler.loss_scale,
+                                          seed.dtype)
         else:
             seed = buf["out_grad"].pop(cmd.buffer_id)
         param_grads, in_grad = vjp_fn(seed)
@@ -446,23 +499,21 @@ class PipelineEngine(DeepSpeedEngine):
     def _exec_send_activation(self, cmd, stage_id, state):
         out = state["buffers"][stage_id]["outputs"][cmd.buffer_id]
         dst = stage_id + 1
-        payload = jax.device_put(out, self.stage_devices[dst][0])
-        state["mail"].setdefault((stage_id, dst), []).append(payload)
+        state["mail"].post(stage_id, dst, self._place_batch(out, dst))
 
     def _exec_recv_activation(self, cmd, stage_id, state):
         src = stage_id - 1
-        payload = state["mail"][(src, stage_id)].pop(0)
+        payload = state["mail"].take(src, stage_id)
         state["buffers"][stage_id]["inputs"][cmd.buffer_id] = payload
 
     def _exec_send_grad(self, cmd, stage_id, state):
         in_grad = state["buffers"][stage_id]["in_grad"].pop(cmd.buffer_id)
         dst = stage_id - 1
-        payload = jax.device_put(in_grad, self.stage_devices[dst][0])
-        state["mail"].setdefault((stage_id, dst), []).append(payload)
+        state["mail"].post(stage_id, dst, self._place_batch(in_grad, dst))
 
     def _exec_recv_grad(self, cmd, stage_id, state):
         src = stage_id + 1
-        payload = state["mail"][(src, stage_id)].pop(0)
+        payload = state["mail"].take(src, stage_id)
         state["buffers"][stage_id]["out_grad"][cmd.buffer_id] = payload
 
     def _exec_reduce_tied_grads(self, cmd, stage_id, state):
@@ -497,13 +548,50 @@ class PipelineEngine(DeepSpeedEngine):
         beta1, beta2 = group.get("betas", (0.9, 0.999))
         clip = self.gradient_clipping()
 
+        # fp16 dynamic-loss-scale bookkeeping (reference pipe engine inherits
+        # the full fp16 step path): grads carry the scale from the backward
+        # seed; on overflow the step is skipped and the scale shrinks.
+        if self.loss_scaler is not None:
+            from deepspeed_tpu.runtime.utils import jit_has_overflow
+            cur_scale = self.loss_scaler.loss_scale
+            # Dispatch every layer's check first, sync once — one blocking
+            # device_get per layer would serialize L host round-trips.
+            flags = [jit_has_overflow(g)
+                     for g in self.grad_acc if g is not None]
+            overflow = any(bool(f) for f in jax.device_get(flags))
+            self.loss_scaler.update_scale(overflow)
+            if overflow:
+                self.skipped_steps += 1
+                log_dist("PIPELINE OVERFLOW! Skipping step. Attempted loss "
+                         "scale: {}, reducing to {}".format(
+                             cur_scale, self.loss_scaler.loss_scale),
+                         ranks=[0])
+                self.grad_acc = [None] * len(self.layers)
+                return
+            inv = 1.0 / cur_scale
+            if inv != 1.0:
+                self.grad_acc = [
+                    jax.tree_util.tree_map(
+                        lambda x: (x.astype(jnp.float32) * inv).astype(
+                            x.dtype), g) if g is not None else None
+                    for g in self.grad_acc]
+
         # Global grad clip across all layers (reference clips globally).
+        # Layers live on different stage submeshes, so per-layer squared norms
+        # are reduced on each stage's devices and combined on host; the scale
+        # factor is then broadcast back into each stage's program.
         if clip > 0.0:
-            flat = [g for g in self.grad_acc if g is not None]
-            clipped, _ = clip_grad_norm_(flat, clip)
-            it = iter(clipped)
-            self.grad_acc = [next(it) if g is not None else None
-                             for g in self.grad_acc]
+            from deepspeed_tpu.runtime.utils import jit_global_norm_sq
+            sqs = [jit_global_norm_sq(g)
+                   for g in self.grad_acc if g is not None]
+            total_norm = sum(float(s) for s in jax.device_get(sqs)) ** 0.5
+            coef = min(clip / (total_norm + 1e-6), 1.0)
+            if coef < 1.0:
+                self.grad_acc = [
+                    jax.tree_util.tree_map(
+                        lambda x: (x.astype(jnp.float32) * coef).astype(
+                            x.dtype), g) if g is not None else None
+                    for g in self.grad_acc]
 
         seen_tied = set()
         for i, params in enumerate(self.layer_params):

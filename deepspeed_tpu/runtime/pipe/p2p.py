@@ -13,11 +13,40 @@ backends can swap the transport later.
 
 import jax
 
+
+class Mailbox(object):
+    """FIFO queues keyed by (src_stage, dest_stage) — the single-controller
+    'wire'. Shared transport for the reference-API send/recv below AND the
+    PipelineEngine's schedule executor (engine.py Send/RecvActivation
+    handlers), so there is exactly one p2p mechanism."""
+
+    def __init__(self):
+        self._q = {}
+
+    def post(self, src_stage, dest_stage, payload):
+        self._q.setdefault((src_stage, dest_stage), []).append(payload)
+
+    def has(self, src_stage, dest_stage):
+        return bool(self._q.get((src_stage, dest_stage)))
+
+    def take(self, src_stage, dest_stage):
+        q = self._q.get((src_stage, dest_stage))
+        if not q:
+            raise RuntimeError(
+                "recv from stage {} before matching send".format(src_stage))
+        return q.pop(0)
+
+    def pending(self):
+        return [v for q in self._q.values() for v in q]
+
+    def clear(self):
+        self._q.clear()
+
+
 _grid = None
 _stage_devices = None
-# In single-controller mode there is no wire: send() stages the (moved)
-# array here and recv() picks it up. Keyed by (src_stage, dest_stage).
-_mailbox = {}
+# Default module-level mailbox backing the reference-API send()/recv().
+_mailbox = Mailbox()
 
 
 def init_process_groups(grid, stage_devices=None):
@@ -58,12 +87,8 @@ def send(tensor, dest_stage, async_op=False):
     src_stage = _grid.get_stage_id() if hasattr(_grid, "get_stage_id") else \
         _grid.stage_id
     _is_valid_send_recv(src_stage, dest_stage)
-    key = (src_stage, dest_stage)
-    assert key not in _mailbox, \
-        "send {}→{} before previous transfer was received".format(
-            src_stage, dest_stage)
     moved = jax.device_put(tensor, _device_of(dest_stage))
-    _mailbox[key] = moved
+    _mailbox.post(src_stage, dest_stage, moved)
     return moved
 
 
@@ -74,11 +99,7 @@ def recv(tensor, src_stage, async_op=False):
     dest_stage = _grid.get_stage_id() if hasattr(_grid, "get_stage_id") else \
         _grid.stage_id
     _is_valid_send_recv(src_stage, dest_stage)
-    key = (src_stage, dest_stage)
-    if key not in _mailbox:
-        raise RuntimeError(
-            "recv from stage {} before matching send".format(src_stage))
-    out = _mailbox.pop(key)
+    out = _mailbox.take(src_stage, dest_stage)
     if tensor is not None and hasattr(tensor, "shape") and \
             tuple(tensor.shape) != tuple(out.shape):
         raise ValueError("recv buffer shape {} != sent shape {}".format(
@@ -88,5 +109,5 @@ def recv(tensor, src_stage, async_op=False):
 
 def barrier(stage_id):
     """Device-level sync (reference :59-67 uses a group barrier)."""
-    for v in _mailbox.values():
+    for v in _mailbox.pending():
         jax.block_until_ready(v)
