@@ -16,10 +16,10 @@ import numpy as np
 
 def flops_per_token(cfg, seq):
     """Training FLOPs per token: 6*N for the dense matmuls plus the causal
-    attention score/value matmuls (2 matmuls x 2 FLOPs x T x C, halved by
-    causality, x3 for fwd+bwd)."""
+    attention score/value matmuls — per layer 2 matmuls x 2 FLOPs x T x C
+    = 4TC fwd, halved by causality to 2TC, x3 for fwd+bwd = 6TC."""
     n_params = cfg.num_params()
-    attn = 6 * cfg.n_layer * seq * cfg.n_embd // 2
+    attn = 6 * cfg.n_layer * seq * cfg.n_embd
     return 6 * n_params + attn
 
 

@@ -96,24 +96,73 @@ def tree_sharding_over_axis(mesh: Mesh, tree, axis_name=DATA_AXIS):
         tree)
 
 
-def zero_shardings(mesh: Mesh, params, stage: int):
-    """(param_sharding, grad_sharding, optstate_leaf_fn) for a ZeRO stage.
+# Megatron-style tensor-parallel rules: (path regex, sharded dim). Column-
+# parallel layers (qkv fusion, mlp up-projection) split their OUTPUT dim and
+# bias; row-parallel layers (attn/mlp down-projection) split their INPUT dim
+# with a replicated bias — XLA inserts the all-reduce the reference delegates
+# to the user's Megatron mpu (SURVEY §0: TP is integrated, not implemented,
+# engine.py:514-525; these rules make it implemented).
+DEFAULT_TP_RULES = (
+    (r".*(attn/c_attn|mlp/c_fc)/kernel$", 1),
+    (r".*(attn/c_attn|mlp/c_fc)/bias$", 0),
+    (r".*(attn|mlp)/c_proj/kernel$", 0),
+)
+
+
+def _tp_dim(path_str, leaf, rules, mp):
+    import re
+    if mp <= 1 or rules is None:
+        return None
+    shape = getattr(leaf, "shape", ())
+    for pattern, dim in rules:
+        if re.match(pattern, path_str) and dim < len(shape) and \
+                shape[dim] % mp == 0:
+            return dim
+    return None
+
+
+def _path_str(path):
+    return "/".join(str(getattr(p, "key", getattr(p, "name", p)))
+                    for p in path)
+
+
+def zero_shardings(mesh: Mesh, params, stage: int, tp_rules=None):
+    """(param_sharding, grad_sharding, optstate_leaf_fn) for a ZeRO stage,
+    composed with tensor parallelism when the mesh has a 'model' axis.
 
     Returns pytrees of NamedSharding for params and grads, plus a function
     mapping an opt-state leaf-template pytree to shardings (moments follow the
-    param policy for their stage).
+    param policy for their stage). A leaf matching a TP rule carries 'model'
+    on its rule dim in EVERY role; the ZeRO 'data' axis lands on the first
+    other divisible dim.
     """
-    rep = replicated(mesh)
-    rep_tree = jax.tree_util.tree_map(lambda _: rep, params)
-    sharded_tree = tree_sharding_over_axis(mesh, params, DATA_AXIS)
+    mp = mp_size(mesh)
+    dp = dp_size(mesh)
+    if tp_rules is None and mp > 1:
+        tp_rules = DEFAULT_TP_RULES
 
-    param_sh = sharded_tree if stage >= 3 else rep_tree
-    grad_sh = sharded_tree if stage >= 2 else rep_tree
+    def leaf_spec(path, leaf, with_data):
+        shape = getattr(leaf, "shape", ())
+        spec = [None] * len(shape)
+        tp = _tp_dim(_path_str(path), leaf, tp_rules, mp)
+        if tp is not None:
+            spec[tp] = MODEL_AXIS
+        if with_data and dp > 1:
+            for dim, size in enumerate(shape):
+                if dim != tp and size % dp == 0 and size >= dp:
+                    spec[dim] = DATA_AXIS
+                    break
+        return NamedSharding(mesh, P(*spec))
+
+    def tree_spec(tree, with_data):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: leaf_spec(path, leaf, with_data), tree)
+
+    param_sh = tree_spec(params, stage >= 3)
+    grad_sh = tree_spec(params, stage >= 2)
 
     def opt_state_sharding(opt_state_template):
-        if stage >= 1:
-            return tree_sharding_over_axis(mesh, opt_state_template, DATA_AXIS)
-        return jax.tree_util.tree_map(lambda _: rep, opt_state_template)
+        return tree_spec(opt_state_template, stage >= 1)
 
     return param_sh, grad_sh, opt_state_sharding
 

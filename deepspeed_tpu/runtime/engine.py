@@ -53,7 +53,9 @@ from deepspeed_tpu.runtime.utils import (
     clip_grad_norm_,
     ensure_directory_exists,
     has_overflow,
+    jit_has_overflow,
 )
+from deepspeed_tpu.runtime.utils import global_norm as utils_global_norm
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 
@@ -445,6 +447,8 @@ class DeepSpeedEngine(object):
 
         self.opt_state = None
         self._offload = None  # host-state bookkeeping (ZeRO-Offload tier)
+        self._offload_pre_fn = None  # jitted device-side unscale+clip
+        self._embed_paths_cache = None  # sparse-grad embedding leaf paths
         if self.params is not None and not self._offload_mode():
             self.opt_state = self.optimizer.init_state(self.params)
 
@@ -540,9 +544,12 @@ class DeepSpeedEngine(object):
         return ProgressiveLayerDrop(theta=self.pld_theta(), gamma=self.pld_gamma())
 
     def _setup_shardings(self):
+        self._embed_paths_cache = None  # params (re)set: recompute lazily
         stage = self.zero_optimization_stage() if self.zero_optimization() else 0
         self.param_sharding, self.grad_sharding, opt_fn = \
-            mesh_lib.zero_shardings(self.mesh, self.params, stage)
+            mesh_lib.zero_shardings(
+                self.mesh, self.params, stage,
+                tp_rules=getattr(self.module, "tp_rules", None))
         if self.opt_state is not None and not self._offload_mode():
             moment_sh = {
                 "step": mesh_lib.replicated(self.mesh),
@@ -628,10 +635,41 @@ class DeepSpeedEngine(object):
                 traced[k] = v
         return static, traced
 
+    def _embedding_grad_paths(self):
+        """Leaf paths of embedding tables (flax nn.Embed 'embedding' params)
+        — the analogue of the reference's nn.Embedding scan
+        (engine.py:180-185) that decides which grads go through the sparse
+        index/value exchange."""
+        if self.params is None:
+            return frozenset()
+        if self._embed_paths_cache is not None:
+            return self._embed_paths_cache
+        # flax nn.Embed stores its table as '<module>/embedding'; the repo's
+        # own models use raw params 'wte' (gpt2.py:149) and BERT-style
+        # '*_embeddings' modules. Tables whose grads turn out dense at
+        # runtime (tied softmax heads) fall back inside
+        # sparse_grad_exchange, so a broad match is safe.
+        embed_names = {"embedding", "wte", "word_embeddings"}
+        paths = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.params)[0]:
+            names = [str(getattr(p, "key", getattr(p, "name", "")))
+                     for p in path]
+            if getattr(leaf, "ndim", 0) >= 2 and any(
+                    n in embed_names or n.endswith("_embeddings")
+                    for n in names):
+                paths.append(tuple(str(p) for p in path))
+        self._embed_paths_cache = frozenset(paths)
+        return self._embed_paths_cache
+
     def _get_fwd_bwd(self, n_args, static_kwargs, traced_keys, train):
+        sparse_embed = bool(
+            train and self.sparse_gradients_enabled()
+            and mesh_lib.dp_size(self.mesh) > 1
+            and self._embedding_grad_paths())
         key = (n_args, tuple(sorted(static_kwargs.items())),
                tuple(sorted(traced_keys)), train, self.compute_dtype.__name__,
-               self._grad_constraint is not None)
+               self._grad_constraint is not None, sparse_embed)
         if key in self._fwd_bwd_cache:
             return self._fwd_bwd_cache[key]
         grad_constraint = self._grad_constraint
@@ -671,9 +709,99 @@ class DeepSpeedEngine(object):
                 grads = jax.lax.with_sharding_constraint(grads, grad_constraint)
             return out, grads
 
-        jitted = jax.jit(loss_and_grads)
+        if sparse_embed:
+            jitted = self._build_sparse_grad_fwd_bwd(
+                static_kwargs=static_kwargs, cast=cast, apply_fn=apply_fn,
+                accepts_deterministic=accepts_deterministic,
+                grad_constraint=grad_constraint)
+        else:
+            jitted = jax.jit(loss_and_grads)
         self._fwd_bwd_cache[key] = jitted
         return jitted
+
+    def _build_sparse_grad_fwd_bwd(self, static_kwargs, cast, apply_fn,
+                                   accepts_deterministic, grad_constraint):
+        """fwd+bwd program with SPARSE embedding-gradient exchange: the loss
+        is computed per data shard under shard_map, dense grads are psum'd,
+        and embedding-table grads are exchanged as (row-index, row-value)
+        pairs bounded by the shard's token count (reference CSR sparse-grad
+        DP, engine.py:180-185,1186-1242)."""
+        from functools import partial
+
+        from jax import shard_map
+
+        from deepspeed_tpu.runtime.csr_tensor import sparse_grad_exchange
+
+        mesh = self.mesh
+        dp = mesh_lib.dp_size(mesh)
+        embed_paths = self._embedding_grad_paths()
+
+        def loss_and_grads(params, args, traced_kwargs, rng, scale):
+            def batch_spec(x):
+                if hasattr(x, "shape") and getattr(x, "ndim", 0) > 0 and \
+                        x.shape[0] % dp == 0:
+                    return jax.sharding.PartitionSpec(mesh_lib.DATA_AXIS)
+                return jax.sharding.PartitionSpec()
+
+            arg_specs = jax.tree_util.tree_map(batch_spec, args)
+            kw_specs = jax.tree_util.tree_map(batch_spec, traced_kwargs)
+            P_ = jax.sharding.PartitionSpec
+
+            @partial(shard_map, mesh=mesh,
+                     in_specs=(P_(), arg_specs, kw_specs, P_(), P_()),
+                     out_specs=(P_(), P_()), check_vma=False)
+            def spmd(params, largs, lkwargs, rng, scale):
+                rng = jax.random.fold_in(
+                    rng, jax.lax.axis_index(mesh_lib.DATA_AXIS))
+
+                def loss_fn(p):
+                    cp = cast(p)
+                    call_kwargs = dict(static_kwargs)
+                    call_kwargs.update(lkwargs)
+                    if accepts_deterministic:
+                        call_kwargs.setdefault("deterministic", False)
+                    out = apply_fn({"params": cp}, *largs,
+                                   rngs={"dropout": rng}, **call_kwargs)
+                    if isinstance(out, tuple):
+                        # Loud, not silent: the sparse path returns only the
+                        # pmean'd scalar, so auxiliary outputs would be
+                        # dropped behind the user's back.
+                        raise NotImplementedError(
+                            "sparse_gradients with data parallelism "
+                            "requires a scalar-loss model output; this "
+                            "model returns a tuple — disable "
+                            "sparse_gradients or return only the loss")
+                    loss = out
+                    assert getattr(loss, "ndim", 0) == 0, \
+                        "sparse_gradients requires a scalar loss output"
+                    return loss * scale, loss
+
+                (_, loss), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                # Token budget = this shard's integer elements (ids+labels):
+                # an embedding grad has at most one nonzero row per token.
+                k = sum(int(np.prod(l.shape)) for l in
+                        jax.tree_util.tree_leaves((largs, lkwargs))
+                        if jnp.issubdtype(l.dtype, jnp.integer)) or None
+
+                def reduce_leaf(path, g):
+                    names = tuple(str(p) for p in path)
+                    if names in embed_paths and k is not None:
+                        return sparse_grad_exchange(
+                            g, mesh_lib.DATA_AXIS, k, average=True)
+                    return jax.lax.pmean(g, mesh_lib.DATA_AXIS)
+
+                grads = jax.tree_util.tree_map_with_path(reduce_leaf, grads)
+                loss = jax.lax.pmean(loss, mesh_lib.DATA_AXIS)
+                return loss, grads
+
+            loss, grads = spmd(params, args, traced_kwargs, rng, scale)
+            if grad_constraint is not None:
+                grads = jax.lax.with_sharding_constraint(grads,
+                                                         grad_constraint)
+            return loss, grads
+
+        return jax.jit(loss_and_grads)
 
     def forward(self, *inputs, **kwargs):
         """Run forward AND backward as one fused XLA program; cache grads.
@@ -853,7 +981,7 @@ class DeepSpeedEngine(object):
         cur_scale = 1.0
         if self.loss_scaler is not None:
             cur_scale = self.loss_scaler.loss_scale
-            overflow = bool(jax.device_get(jax.jit(has_overflow)(grads)))
+            overflow = bool(jax.device_get(jit_has_overflow(grads)))
             self.loss_scaler.update_scale(overflow)
 
         if overflow:
@@ -941,48 +1069,120 @@ class DeepSpeedEngine(object):
             "exp_avg": views(m),
             "exp_avg_sq": views(v),
         }
+        # The fp32 master now lives on host — device params drop to the
+        # compute dtype (the reference keeps fp16 params on device + fp32
+        # masters in pinned CPU memory, stage2.py:156,326-342). At 1.5B this
+        # halves params+grads HBM from 12.4 GB to 6.2 GB.
+        if self.compute_dtype != jnp.float32:
+            cast = self._cast_to_compute
+            self.params = cast(self.params)
+
+    def _get_offload_pre_fn(self):
+        """Jitted DEVICE-side unscale + global-norm clip, run BEFORE the
+        host copy (the reference computes grad norms GPU-side pre-copy,
+        stage2.py:818-840; doing it on host serialized the whole step)."""
+        if self._offload_pre_fn is not None:
+            return self._offload_pre_fn
+        clip = self.gradient_clipping()
+
+        def pre(grads, inv_scale):
+            # Norms in f32, storage kept in the grad dtype, input buffers
+            # donated: at 1.5B+ a full fp32 copy of the grads alongside the
+            # bf16 originals would OOM a 16 GB chip.
+            scale = inv_scale
+            if clip > 0.0:
+                norm = utils_global_norm(grads)
+                scale = scale * jnp.minimum(
+                    clip / (norm * inv_scale + 1e-6), 1.0)
+            return jax.tree_util.tree_map(
+                lambda x: (x.astype(jnp.float32) * scale).astype(x.dtype),
+                grads)
+
+        self._offload_pre_fn = jax.jit(pre, donate_argnums=0)
+        return self._offload_pre_fn
+
+    def _offload_chunks(self):
+        """Group flat-buffer leaf indices into ~16 MB transfer chunks for the
+        copy/compute/copy pipeline."""
+        target = 4 * 1024 * 1024  # fp32 elements (~16 MB)
+        chunks, cur, cur_n = [], [], 0
+        for i, size in enumerate(self._offload["sizes"]):
+            cur.append(i)
+            cur_n += size
+            if cur_n >= target:
+                chunks.append(cur)
+                cur, cur_n = [], 0
+        if cur:
+            chunks.append(cur)
+        return chunks
 
     def _offload_step(self, grads, inv_scale, lr):
-        """Host-side optimizer step (the reference's cpu-offload methods
-        block, stage2.py:740-940 + DeepSpeedCPUAdam.step)."""
+        """Pipelined host optimizer step (reference's cpu-offload block,
+        stage2.py:740-940 + DeepSpeedCPUAdam.step): grads are unscaled and
+        clipped on device, streamed to host in chunks with
+        copy_to_host_async, and the C++ OpenMP Adam runs on chunk i while
+        chunk i+1 is still in flight and chunk i-1's updated params upload
+        (async dispatch) — the double-buffering the reference builds with
+        pinned memory + a migration stream (stage2.py:775-817)."""
         if self._offload is None:
             self._init_offload()
         off = self._offload
         opt = self.optimizer
 
-        host_g = np.empty(off["total"], np.float32)
+        grads = self._get_offload_pre_fn()(grads, jnp.float32(inv_scale))
         g_leaves = off["treedef"].flatten_up_to(grads)
-        for leaf, o, size in zip(g_leaves, off["offsets"][:-1], off["sizes"]):
-            host_g[o:o + size] = np.asarray(
-                jax.device_get(leaf), dtype=np.float32).ravel()
-
-        if inv_scale != 1.0:
-            opt.scale_(host_g, inv_scale)
-        clip = self.gradient_clipping()
-        if clip > 0.0:
-            gnorm = opt.l2_norm(host_g)
-            if gnorm > clip:
-                opt.scale_(host_g, clip / (gnorm + 1e-6))
+        del grads
+        for leaf in g_leaves:
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
 
         off["step"] += 1
-        opt.step_flat(off["master"], host_g, off["m"], off["v"],
-                      step=off["step"], lr=lr)
-        self.opt_state["step"] = np.int32(off["step"])
-
-        # Re-materialize device params from the updated host master.
+        param_leaves = off["treedef"].flatten_up_to(self.params)
+        dtypes = [l.dtype for l in param_leaves]
         shard_leaves = off["treedef"].flatten_up_to(self.param_sharding) \
             if self._shardings_ready else [None] * len(off["sizes"])
-        param_leaves = off["treedef"].flatten_up_to(self.params)
-        new_leaves = []
-        for old, o, size, shape, sh in zip(param_leaves, off["offsets"][:-1],
-                                           off["sizes"], off["shapes"],
-                                           shard_leaves):
-            host = off["master"][o:o + size].reshape(shape)
-            arr = jnp.asarray(host, dtype=old.dtype)
-            if sh is not None:
-                arr = jax.device_put(arr, sh)
-            new_leaves.append(arr)
-        self.params = jax.tree_util.tree_unflatten(off["treedef"], new_leaves)
+        new_leaves = [None] * len(param_leaves)
+        # Release the old device params: the master (host) is authoritative,
+        # and at 1.5B+ holding old params + grads + new params concurrently
+        # would exceed a 16 GB chip. Leaves free as their refs drop. The
+        # finally-block re-materializes params from the master even if a
+        # chunk fails mid-loop — otherwise the next forward() would see
+        # params=None and silently re-initialize fresh weights.
+        self.params = None
+        del param_leaves
+
+        def upload(i):
+            o, size = int(off["offsets"][i]), off["sizes"][i]
+            host = off["master"][o:o + size].reshape(off["shapes"][i])
+            arr = jnp.asarray(host, dtype=dtypes[i])
+            if shard_leaves[i] is not None:
+                arr = jax.device_put(arr, shard_leaves[i])
+            return arr
+
+        try:
+            for chunk in self._offload_chunks():
+                lo = int(off["offsets"][chunk[0]])
+                hi = int(off["offsets"][chunk[-1]] + off["sizes"][chunk[-1]])
+                host_g = np.empty(hi - lo, np.float32)
+                for i in chunk:
+                    o, size = int(off["offsets"][i]), off["sizes"][i]
+                    host_g[o - lo:o - lo + size] = np.asarray(
+                        g_leaves[i], dtype=np.float32).ravel()
+                    g_leaves[i] = None  # free this grad leaf's HBM now
+                opt.step_flat(off["master"][lo:hi], host_g,
+                              off["m"][lo:hi], off["v"][lo:hi],
+                              step=off["step"], lr=lr)
+                # Upload this chunk's updated params; device_put dispatches
+                # asynchronously, overlapping the next chunk's host Adam.
+                for i in chunk:
+                    new_leaves[i] = upload(i)
+        finally:
+            del g_leaves
+            self.params = jax.tree_util.tree_unflatten(
+                off["treedef"],
+                [leaf if leaf is not None else upload(i)
+                 for i, leaf in enumerate(new_leaves)])
+        self.opt_state["step"] = np.int32(off["step"])
 
     def step(self, lr_kwargs=None):
         """Weight update at gradient-accumulation boundaries
@@ -1182,36 +1382,139 @@ class DeepSpeedEngine(object):
         logger.info("Saving model checkpoint: {}".format(save_path))
 
         if self.zero_optimization():
-            zero_path = self._get_zero_ckpt_name(save_dir, tag)
-            ensure_directory_exists(zero_path)
-            with open(zero_path, "wb") as f:
-                pickle.dump({"optimizer_state_dict":
-                             self._optimizer_state_for_save()}, f)
+            self._save_zero_checkpoint(save_dir, tag)
 
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as fd:
                 fd.write(tag)
         return True
 
+    def _save_zero_checkpoint(self, save_dir, tag):
+        """Write the zero optim-state files. With elastic_checkpoint (the
+        default, reference zero/config.py:25), state is split into one
+        world-size-agnostic shard file per dp rank (reference
+        stage1.py:848-1078's elastic format): a later load at a DIFFERENT dp
+        world size reassembles the full logical state from however many shard
+        files exist and re-partitions onto the current mesh."""
+        opt_sd = self._optimizer_state_for_save()
+        elastic = self.zero_elastic_checkpoint() and not self._offload_mode()
+        dp_world = mesh_lib.dp_size(self.mesh)
+        if not elastic or dp_world <= 1:
+            zero_path = self._get_zero_ckpt_name(save_dir, tag)
+            ensure_directory_exists(zero_path)
+            with open(zero_path, "wb") as f:
+                pickle.dump({"optimizer_state_dict": opt_sd}, f)
+            return
+        state_host = opt_sd.pop("state")
+        for r in range(dp_world):
+            zero_path = self._get_zero_ckpt_name(save_dir, tag, dp_rank=r)
+            ensure_directory_exists(zero_path)
+            with open(zero_path, "wb") as f:
+                pickle.dump({
+                    "optimizer_state_dict": opt_sd,
+                    "state_shards": self._partition_state_for_rank(
+                        state_host, r, dp_world),
+                    "zero_dp_world_size": dp_world,
+                }, f)
+
+    def _partition_state_for_rank(self, state_host, dp_rank, dp_world):
+        """Shard one dp rank's slice of host optimizer state. Each leaf
+        becomes ('shard', dim, slice) along its data-sharded dim, or
+        ('full', array) in rank 0's file only (replicated/indivisible
+        leaves — e.g. the scalar step, small biases)."""
+        def slice_leaf(leaf):
+            arr = np.asarray(leaf)
+            spec = mesh_lib._leaf_spec_over_axis(arr, mesh_lib.DATA_AXIS,
+                                                 dp_world)
+            dim = next((i for i, ax in enumerate(spec)
+                        if ax == mesh_lib.DATA_AXIS), None)
+            if dim is None:
+                return ("full", arr) if dp_rank == 0 else ("ref",)
+            per = arr.shape[dim] // dp_world
+            idx = [slice(None)] * arr.ndim
+            idx[dim] = slice(dp_rank * per, (dp_rank + 1) * per)
+            return ("shard", dim, arr[tuple(idx)])
+
+        return jax.tree_util.tree_map(slice_leaf, state_host)
+
+    @staticmethod
+    def _merge_state_shards(shard_trees):
+        """Inverse of _partition_state_for_rank: reassemble the full logical
+        state from every saved dp rank's shard tree."""
+        def merge(*entries):
+            first = entries[0]
+            if first[0] == "full" or first[0] == "ref":
+                full = next(e for e in entries if e[0] == "full")
+                return full[1]
+            dim = first[1]
+            return np.concatenate([e[2] for e in entries], axis=dim)
+
+        return jax.tree_util.tree_map(
+            merge, *shard_trees,
+            is_leaf=lambda x: isinstance(x, tuple) and len(x) > 0 and
+            x[0] in ("full", "ref", "shard"))
+
     def _optimizer_state_for_save(self):
         sd = {"state": self._to_host(self.opt_state)
               if self.opt_state is not None else None}
+        if self._offload_mode() and self._offload is not None:
+            # Persist the host fp32 master weights: resume must keep full
+            # master precision (reference saves
+            # single_partition_of_fp32_groups, stage2.py:1704); rebuilding
+            # from bf16 params would drift the training trajectory.
+            sd["fp32_master"] = self._offload["master"].copy()
         if hasattr(self.optimizer, "state_dict"):
             sd.update(self.optimizer.state_dict())
         return sd
 
+    def _load_zero_state(self, load_dir, tag):
+        """Read zero optim-state file(s). Elastic layout: every saved dp
+        rank's shard file is read and the full logical state reassembled, so
+        loading at a different dp world size than the save re-partitions
+        naturally (reference engine.py:1376-1442 + stage1.py:946-1023)."""
+        zero_path = self._get_zero_ckpt_name(load_dir, tag, dp_rank=0)
+        if not os.path.exists(zero_path):
+            return None
+        with open(zero_path, "rb") as f:
+            head = pickle.load(f)
+        if "state_shards" not in head:
+            return head["optimizer_state_dict"]  # non-elastic single file
+        saved_world = head["zero_dp_world_size"]
+        shard_trees = [head["state_shards"]]
+        for r in range(1, saved_world):
+            path_r = self._get_zero_ckpt_name(load_dir, tag, dp_rank=r)
+            assert os.path.exists(path_r), (
+                "elastic zero checkpoint saved at dp={} is missing shard "
+                "file {}".format(saved_world, path_r))
+            with open(path_r, "rb") as f:
+                shard_trees.append(pickle.load(f)["state_shards"])
+        opt_sd = dict(head["optimizer_state_dict"])
+        opt_sd["state"] = self._merge_state_shards(shard_trees)
+        if saved_world != mesh_lib.dp_size(self.mesh):
+            log_dist("elastic zero checkpoint: re-partitioning optimizer "
+                     "state saved at dp={} onto dp={}".format(
+                         saved_world, mesh_lib.dp_size(self.mesh)), ranks=[0])
+        return opt_sd
+
     def _checkpoint_tag_validation(self, tag):
-        """Cross-rank tag consistency (reference engine.py:1444-1459). In
-        single-controller JAX every chip sees the same tag; we keep the
-        hash-compare for multi-process launches."""
+        """Cross-rank tag consistency (reference engine.py:1444-1459): every
+        process sha1-hashes the tag, hashes are all-gathered over processes,
+        and a mismatch warns or fails per checkpoint_tag_validation_fail. In
+        a single-process (single-controller) run the gather is trivial."""
         if not self.checkpoint_tag_validation_enabled():
             return
         tag_hash = hashlib.sha1(str(tag).encode()).hexdigest()
-        # Multi-host: all processes would compare psum'd hashes; single
-        # process trivially passes.
-        valid = True
-        msg = "checkpoint tag '{}' consistent across ranks".format(tag)
+        local = np.frombuffer(bytes.fromhex(tag_hash), np.uint8)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            gathered = np.asarray(
+                multihost_utils.process_allgather(local))
+            valid = bool((gathered == gathered[0]).all())
+        else:
+            valid = True
         if not valid:
+            msg = "checkpoint tag '{}' inconsistent across ranks: not all " \
+                  "processes computed the same tag hash".format(tag)
             if self.checkpoint_tag_validation_fail():
                 raise RuntimeError(msg)
             logger.warning(msg)
@@ -1258,10 +1561,7 @@ class DeepSpeedEngine(object):
         if load_optimizer_states:
             opt_sd = None
             if self.zero_optimization():
-                zero_path = self._get_zero_ckpt_name(load_dir, tag)
-                if os.path.exists(zero_path):
-                    with open(zero_path, "rb") as f:
-                        opt_sd = pickle.load(f)["optimizer_state_dict"]
+                opt_sd = self._load_zero_state(load_dir, tag)
             else:
                 opt_sd = checkpoint.get("optimizer")
             if opt_sd is not None and opt_sd.get("state") is not None:
@@ -1276,6 +1576,12 @@ class DeepSpeedEngine(object):
                                                  off["sizes"]):
                             buf[o:o + size] = np.asarray(leaf,
                                                          np.float32).ravel()
+                    if opt_sd.get("fp32_master") is not None:
+                        # Full-precision master resume (reference
+                        # load_from_fp32_weights, stage2.py:1718-1741): the
+                        # saved fp32 buffer is authoritative, not the bf16
+                        # module params _init_offload rebuilt it from.
+                        off["master"][:] = opt_sd["fp32_master"]
                     off["step"] = int(saved["step"])
                     self.opt_state["step"] = np.int32(off["step"])
                 else:

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.runtime.fp16.loss_scaler import (CreateLossScaler,
                                                     DynamicLossScaler)
-from deepspeed_tpu.runtime.utils import clip_grad_norm_, has_overflow
+from deepspeed_tpu.runtime.utils import clip_grad_norm_, jit_has_overflow
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -91,7 +91,7 @@ class FP16_Optimizer(object):
         Returns (params, state, overflow) — overflow True means the step was
         skipped and the scale reduced (reference fused_optimizer.py:176-240).
         """
-        self.overflow = bool(jax.device_get(jax.jit(has_overflow)(grads)))
+        self.overflow = bool(jax.device_get(jit_has_overflow(grads)))
         prev_scale = self.cur_scale
         self.loss_scaler.update_scale(self.overflow)
         if self.overflow:

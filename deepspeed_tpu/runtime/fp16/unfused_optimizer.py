@@ -9,7 +9,13 @@ FP16_Optimizer core and differs only in the LAMB-specific step entry
 kept as a distinct class so reference call sites port unchanged.
 """
 
+import jax
+import jax.numpy as jnp
+
 from deepspeed_tpu.runtime.fp16.fused_optimizer import FP16_Optimizer
+from deepspeed_tpu.runtime.utils import (clip_grad_norm_, global_norm,
+                                         jit_has_overflow)
+from deepspeed_tpu.utils.logging import logger
 
 
 class FP16_UnfusedOptimizer(FP16_Optimizer):
@@ -30,8 +36,67 @@ class FP16_UnfusedOptimizer(FP16_Optimizer):
                          mpu=mpu,
                          clip_grad=clip_grad)
         self.fused_lamb_legacy = fused_lamb_legacy
+        self._lamb_update_fn = None
+
+    def _get_lamb_update(self):
+        """Jitted LAMB step with the reference's combined-scale semantics
+        (unfused_optimizer.py:118-174): the global grad norm is computed
+        once and folded into the unscale factor so grads exceeding the
+        group's ``max_grad_norm`` are clipped BEFORE the moment update —
+        the norm the reference passes into the CUDA lamb kernel as
+        grad_norms/combined_scale."""
+        if self._lamb_update_fn is None:
+            optimizer = self.optimizer
+            group = optimizer.param_groups[0]
+            max_grad_norm = float(group.get("max_grad_norm", 0.0) or 0.0)
+
+            clip = self.clip_grad
+
+            def update(params, grads, state, inv_scale, lr, beta1, beta2):
+                g = jax.tree_util.tree_map(
+                    lambda x: x.astype(jnp.float32) * inv_scale, grads)
+                if max_grad_norm > 0.0:
+                    norm = global_norm(g)
+                    coef = jnp.maximum(norm / max_grad_norm, 1.0)
+                    g = jax.tree_util.tree_map(lambda x: x / coef, g)
+                if clip > 0.0:
+                    # clip_grad applies on the LAMB path too — step() also
+                    # routes FusedLamb here, and dropping the wrapper-level
+                    # clip would silently change trajectories.
+                    g, _ = clip_grad_norm_(g, clip)
+                return optimizer.update(params, g, state, lr=lr,
+                                        betas=(beta1, beta2))
+
+            self._lamb_update_fn = jax.jit(update)
+        return self._lamb_update_fn
 
     def step_fused_lamb(self, params, grads, state, closure=None):
-        """LAMB step with overflow handling (reference :118-174); the trust
-        ratio lives in the inner FusedLamb update."""
-        return self.step(params, grads, state, closure=closure)
+        """LAMB step with overflow handling + max_grad_norm pre-clipping
+        (reference :118-174); the trust ratio lives in the inner FusedLamb
+        update."""
+        self.overflow = bool(jax.device_get(jit_has_overflow(grads)))
+        prev_scale = self.cur_scale
+        self.loss_scaler.update_scale(self.overflow)
+        if self.overflow:
+            self.skipped_steps += 1
+            if self.verbose:
+                logger.info(
+                    "[deepspeed] OVERFLOW! Skipping LAMB step. Attempted "
+                    "loss scale: %s, reducing to %s", prev_scale,
+                    self.cur_scale)
+            return params, state, True
+        group = self.optimizer.param_groups[0]
+        beta1, beta2 = group.get("betas", (0.9, 0.999))
+        params, state = self._get_lamb_update()(
+            params, grads, state, jnp.float32(1.0 / prev_scale),
+            jnp.float32(group["lr"]), jnp.float32(beta1),
+            jnp.float32(beta2))
+        return params, state, False
+
+    def step(self, params, grads, state, closure=None):
+        """Route through the LAMB path when wrapping FusedLamb (the
+        reference dispatches on fused_lamb_legacy, :103-116)."""
+        if hasattr(self.optimizer, "max_coeff") or self.fused_lamb_legacy:
+            return self.step_fused_lamb(params, grads, state,
+                                        closure=closure)
+        return super().step(params, grads, state, closure=closure)

@@ -85,6 +85,14 @@ def global_norm(tree):
     return jnp.sqrt(sq)
 
 
+# Jitted-once host-call helpers. `jax.jit(f)` builds a NEW wrapper (and trace
+# cache) per call — constructing one inside a training step would retrace
+# every step. These singletons compile once per pytree structure.
+jit_has_overflow = jax.jit(has_overflow, static_argnames=("mp_axis",))
+jit_global_norm_sq = jax.jit(
+    lambda tree: jnp.square(global_norm(tree)))
+
+
 def get_grad_norm(gradients, norm_type=2, mp_axis=None):
     """Gradient norm; inf-norm and 2-norm supported (reference utils.py:148-203).
 

@@ -185,3 +185,32 @@ def test_offload_checkpoint_roundtrip(tmp_path):
     engine2.backward(loss)
     engine2.step()
     assert int(engine2.opt_state["step"]) == 4
+
+
+def test_offload_checkpoint_preserves_fp32_master(tmp_path):
+    """Resume must keep FULL master precision (reference saves
+    single_partition_of_fp32_groups, stage2.py:1704): a save/load round-trip
+    restores the fp32 master bitwise, NOT a bf16-truncated rebuild from the
+    module params."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.simple import SimpleModel
+    rng = np.random.RandomState(2)
+    x = rng.randn(8, 8).astype(np.float32)
+    y = rng.randint(0, 8, size=(8,))
+    engine = _make_offload_engine()
+    for _ in range(3):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    master_before = engine._offload["master"].copy()
+    # the master must hold precision a bf16 round-trip would destroy
+    bf16_roundtrip = np.asarray(master_before.astype(jnp.bfloat16),
+                                dtype=np.float32)
+    assert not np.array_equal(master_before, bf16_roundtrip)
+    engine.save_checkpoint(str(tmp_path))
+
+    engine2 = _make_offload_engine()
+    engine2(x, y)
+    engine2.load_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(engine2._offload["master"], master_before)

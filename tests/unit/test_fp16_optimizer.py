@@ -95,3 +95,35 @@ def test_unfused_lamb_step():
     p2, s2, overflow = opt.step_fused_lamb(params, grads, state)
     assert not overflow
     assert not np.allclose(np.asarray(p2["w"]), np.asarray(params["w"]))
+
+
+def test_unfused_lamb_max_grad_norm_clips():
+    """step_fused_lamb must fold the global grad norm into the unscale
+    factor when max_grad_norm is set (reference unfused_optimizer.py:118-174
+    passes grad norms into the lamb kernel): oversized grads are normalized
+    before the moment update, so the step equals one taken with
+    pre-normalized grads."""
+    rng = np.random.RandomState(3)
+    params = {"w": jnp.asarray(rng.randn(16).astype(np.float32))}
+    big = {"w": jnp.asarray(rng.randn(16).astype(np.float32)) * 100.0}
+    norm = float(jnp.linalg.norm(big["w"]))
+
+    opt_clip = FP16_UnfusedOptimizer(
+        FusedLamb(lr=1e-2, max_grad_norm=1.0), static_loss_scale=1.0)
+    p_clip, _, ov = opt_clip.step_fused_lamb(
+        params, big, opt_clip.init_state(params))
+    assert not ov
+
+    opt_ref = FP16_UnfusedOptimizer(FusedLamb(lr=1e-2),
+                                    static_loss_scale=1.0)
+    p_ref, _, _ = opt_ref.step_fused_lamb(
+        params, {"w": big["w"] / norm}, opt_ref.init_state(params))
+    np.testing.assert_allclose(np.asarray(p_clip["w"]),
+                               np.asarray(p_ref["w"]), rtol=1e-5)
+
+    # and the generic step() routes FusedLamb through the lamb path
+    opt2 = FP16_UnfusedOptimizer(FusedLamb(lr=1e-2, max_grad_norm=1.0),
+                                 static_loss_scale=1.0)
+    p_step, _, _ = opt2.step(params, big, opt2.init_state(params))
+    np.testing.assert_allclose(np.asarray(p_step["w"]),
+                               np.asarray(p_clip["w"]), rtol=1e-6)

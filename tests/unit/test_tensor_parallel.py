@@ -1,0 +1,91 @@
+"""Tensor parallelism over the 'model' mesh axis: Megatron-style sharding
+rules applied by the engine (the reference only INTEGRATES an external mpu,
+engine.py:514-525 / topology.py:246-249; here the framework implements the
+sharding itself via GSPMD)."""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+
+def _make_engine(num_mp, num_dp, zero_stage=0, seed=0):
+    devices = jax.devices()[:num_mp * num_dp]
+    mesh = mesh_lib.build_mesh(devices=devices, num_mp=num_mp, num_dp=num_dp)
+    cfg = GPT2Config.tiny(use_flash_attention=False)
+    model = GPT2LMHeadModel(cfg)
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+    }
+    if zero_stage:
+        config["zero_optimization"] = {"stage": zero_stage}
+    engine, _, _, _ = deepspeed.initialize(model=model, mesh=mesh,
+                                           config_params=config)
+    return engine, cfg
+
+
+def _run(engine, cfg, steps=4):
+    losses = []
+    for i in range(steps):
+        rng = np.random.RandomState(i % 2)
+        ids = rng.randint(0, cfg.vocab_size, size=(8, 16))
+        loss = engine(ids, ids)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+def test_tp_params_sharded_over_model_axis(eight_devices):
+    """qkv/mlp kernels must actually be sliced over 'model': each device
+    holds a 1/mp column (or row) block, not a replica."""
+    engine, cfg = _make_engine(num_mp=4, num_dp=2)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(8, 16))
+    engine(ids, ids)  # materialize params
+
+    qkv = engine.params["h_0"]["attn"]["c_attn"]["kernel"]
+    assert qkv.shape == (cfg.n_embd, 3 * cfg.n_embd)
+    shard = qkv.addressable_shards[0].data
+    assert shard.shape == (cfg.n_embd, 3 * cfg.n_embd // 4)
+
+    fc = engine.params["h_0"]["mlp"]["c_fc"]["kernel"]
+    assert fc.addressable_shards[0].data.shape == \
+        (cfg.n_embd, 4 * cfg.n_embd // 4)
+    proj = engine.params["h_0"]["mlp"]["c_proj"]["kernel"]
+    assert proj.addressable_shards[0].data.shape == \
+        (4 * cfg.n_embd // 4, cfg.n_embd)
+    # layer norms replicate
+    ln = engine.params["h_0"]["ln_1"]["scale"]
+    assert ln.addressable_shards[0].data.shape == ln.shape
+
+
+def test_tp_loss_parity_vs_data_parallel(eight_devices):
+    """mp=4 x dp=2 must train the same trajectory as pure dp=8 (GSPMD value
+    semantics: sharding changes comm, not math)."""
+    tp_engine, cfg = _make_engine(num_mp=4, num_dp=2)
+    dp_engine, _ = _make_engine(num_mp=1, num_dp=8)
+    tp_losses = _run(tp_engine, cfg)
+    dp_losses = _run(dp_engine, cfg)
+    np.testing.assert_allclose(tp_losses, dp_losses, rtol=2e-2)
+    assert tp_losses[-1] < tp_losses[0]
+
+
+def test_tp_composes_with_zero3(eight_devices):
+    """ZeRO-3 + TP: a qkv kernel carries BOTH axes — 'model' on its output
+    dim and 'data' on another dim — so each device holds 1/(mp*dp)."""
+    engine, cfg = _make_engine(num_mp=4, num_dp=2, zero_stage=3)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(8, 16))
+    loss = engine(ids, ids)
+    engine.backward(loss)
+    engine.step()
+    assert np.isfinite(float(loss))
+    qkv = engine.params["h_0"]["attn"]["c_attn"]["kernel"]
+    frac = qkv.addressable_shards[0].data.size / qkv.size
+    assert frac == pytest.approx(1.0 / 8)
