@@ -148,12 +148,15 @@ class DeepSpeedCPUAdam(object):
         if closure is not None:
             loss = closure()
         self._step += 1
-        for group in self.param_groups:
+        for gi, group in enumerate(self.param_groups):
             params = group.get("params") or []
-            for p in params:
+            for pi, p in enumerate(params):
                 if not isinstance(p, dict) or p.get("grads") is None:
                     continue
-                key = id(p)
+                # Keyed by (group index, position) — stable when the caller
+                # rebuilds the param dicts between steps; id(p) could be
+                # silently reused after GC and cross-wire moments.
+                key = (gi, pi)
                 if key not in self.state:
                     self.state[key] = {
                         "exp_avg": np.zeros_like(p["params"]),

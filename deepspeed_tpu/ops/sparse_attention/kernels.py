@@ -345,8 +345,46 @@ def _make_fn(fwd_lut, bwd_lut, blk, scale, causal, has_kpm, has_bias,
             interpret=_interpret(),
         )(*args)
 
+        # The key-padding mask is an input mask, never a learned parameter:
+        # its cotangent is defined as zero (documented non-differentiable).
         dkpm = None if kpm is None else jnp.zeros_like(kpm)
-        dbias = None if bias is None else jnp.zeros_like(bias)
+        # attn_bias CAN be learned (the reference's rpe receives real grads
+        # under torch autograd), so its cotangent must be real: reconstruct
+        # p and dS densely — the bias is already a dense [B,H,T,T] tensor,
+        # so its gradient is inherently dense-sized and this costs two
+        # einsums, comparable to one bwd kernel pass.
+        dbias = None
+        if bias is not None:
+            f32 = jnp.float32
+            s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(f32), k.astype(f32),
+                           preferred_element_type=f32) * scale
+            if kpm is not None:
+                kpm_b = kpm.astype(f32)[:, None, None, :]
+                s = s * kpm_b if kpm_mode == 'mul' else s + kpm_b
+            s_pre_bias = s
+            bias_f = bias.astype(f32)
+            s = s * bias_f if bias_mode == 'mul' else s + bias_f
+            # layout block mask (from the LUT: listed kv-block columns),
+            # then the causal mask — matching _apply_masks exactly.
+            nq = t // blk
+            valid_blocks = np.zeros((h, nq, nq), bool)
+            for h_ in range(h):
+                for i_ in range(nq):
+                    cols = fwd_lut[h_, i_]
+                    valid_blocks[h_, i_, cols[cols >= 0]] = True
+            valid = jnp.asarray(np.repeat(np.repeat(
+                valid_blocks, blk, axis=1), blk, axis=2))[None]
+            if causal:
+                pos = np.arange(t)
+                valid = valid & jnp.asarray(
+                    pos[:, None] >= pos[None, :])[None, None]
+            s = jnp.where(valid, s, NEG_INF)
+            p = jnp.exp(s - lse.astype(f32))
+            dp = jnp.einsum("bhqd,bhkd->bhqk", do.astype(f32),
+                            v.astype(f32), preferred_element_type=f32)
+            dS = p * (dp - delta.astype(f32))
+            dbias = dS if bias_mode != 'mul' else dS * s_pre_bias
+            dbias = jnp.where(valid, dbias, 0.0).astype(bias.dtype)
         return dq, dk, dv, dkpm, dbias
 
     attend.defvjp(attend_fwd, attend_bwd)

@@ -71,16 +71,19 @@ def sparse_self_attention(query, key, value, sparsity_config, rpe=None,
         attn_bias=bias, attn_bias_mode=bias_mode)
 
 
-_LAYOUT_CACHE = {}
+# Keyed by a weak reference to the config object so a garbage-collected
+# config can never alias a new one's cache slot (id() reuse), and entries die
+# with their config.
+import weakref
+
+_LAYOUT_CACHE = weakref.WeakKeyDictionary()
 
 
 def _layout_for(config, seq_len):
-    key = (id(config), seq_len)
-    layout = _LAYOUT_CACHE.get(key)
-    if layout is None:
-        layout = config.make_layout(seq_len)
-        _LAYOUT_CACHE[key] = layout
-    return layout
+    per_config = _LAYOUT_CACHE.setdefault(config, {})
+    if seq_len not in per_config:
+        per_config[seq_len] = config.make_layout(seq_len)
+    return per_config[seq_len]
 
 
 def _broadcast_bias(x, b, h, t):

@@ -193,7 +193,12 @@ class VariableSparsityConfig(SparsityConfig):
         # Unlike the reference (which consumes python's global `random`), the
         # random pattern is seedable so layouts are reproducible trace-time
         # constants — required for jit cache stability across processes.
-        self._rng = np.random.default_rng(seed)
+        # seed=None still gets ONE concrete seed here: default_rng(None)
+        # would draw fresh entropy on every reseed and break the repeated-
+        # make_layout invariant below.
+        self._seed = seed if seed is not None else \
+            int(np.random.default_rng().integers(2 ** 31))
+        self._rng = np.random.default_rng(self._seed)
 
     def set_random_layout(self, h, layout):
         num_blocks = layout.shape[1]
@@ -251,6 +256,10 @@ class VariableSparsityConfig(SparsityConfig):
         return layout
 
     def make_layout(self, seq_len):
+        # Reseed per call: repeated make_layout on one config must yield the
+        # SAME layout (callers treat the layout as a pure function of the
+        # config; a stateful rng would silently diverge between calls).
+        self._rng = np.random.default_rng(self._seed)
         layout = self.setup_layout(seq_len)
         for h in range(self.num_layout_heads):
             layout = self.set_random_layout(h, layout)
@@ -275,7 +284,9 @@ class BigBirdSparsityConfig(SparsityConfig):
         self.num_random_blocks = num_random_blocks
         self.num_sliding_window_blocks = num_sliding_window_blocks
         self.num_global_blocks = num_global_blocks
-        self._rng = np.random.default_rng(seed)
+        self._seed = seed if seed is not None else \
+            int(np.random.default_rng().integers(2 ** 31))
+        self._rng = np.random.default_rng(self._seed)
 
     def set_random_layout(self, h, layout):
         num_blocks = layout.shape[1]
@@ -315,6 +326,9 @@ class BigBirdSparsityConfig(SparsityConfig):
         return layout
 
     def make_layout(self, seq_len):
+        # Reseed per call so repeated layouts are identical (see
+        # VariableSparsityConfig.make_layout).
+        self._rng = np.random.default_rng(self._seed)
         layout = self.setup_layout(seq_len)
         for h in range(self.num_layout_heads):
             layout = self.set_random_layout(h, layout)
