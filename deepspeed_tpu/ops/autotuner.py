@@ -1,0 +1,141 @@
+"""Per-shape kernel autotuner — the TPU analog of the reference's GEMM
+algorithm sweeps (csrc/includes/gemm_test.h:27,141: GemmTest/StridedGemmTest
+try every cublas algo at layer construction and pick the fastest).
+
+On TPU the tunable axis is Pallas tile sizes, not cublas algos. Selection
+order per (kernel, shape-signature) key:
+
+1. in-process memo;
+2. a bundled offline table shipped with the package (tuned on real
+   hardware, keyed by platform);
+3. a user cache file (~/.cache/deepspeed_tpu/autotune.json), populated by
+   online sweeps;
+4. when ``DS_TPU_AUTOTUNE=1``, an online sweep: time every candidate with
+   compile excluded (one warmup, then min of ``repeats``), persist the
+   winner to the user cache. Otherwise: the caller's default.
+
+Online sweeps cost one kernel compile per candidate (~20-40 s each on a
+cold remote-compile tunnel), so they are opt-in — like the reference, which
+also pays its sweep at layer creation, not silently per step.
+"""
+
+import json
+import os
+import time
+
+import jax
+
+_MEMO = {}
+_BUNDLED = None
+_USER = None
+
+_BUNDLED_PATH = os.path.join(os.path.dirname(__file__), "autotune_table.json")
+
+
+def _user_cache_path():
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "deepspeed_tpu", "autotune.json")
+
+
+def _load(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _tables():
+    global _BUNDLED, _USER
+    if _BUNDLED is None:
+        _BUNDLED = _load(_BUNDLED_PATH)
+    if _USER is None:
+        _USER = _load(_user_cache_path())
+    return _BUNDLED, _USER
+
+
+def online_enabled():
+    return os.environ.get("DS_TPU_AUTOTUNE", "0") not in ("0", "", "false")
+
+
+def _sync(out):
+    """Execution barrier via a scalar VALUE fetch: on remote-device
+    platforms block_until_ready can return before execution finishes, which
+    would time async dispatch instead of the kernel."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(leaf.ravel()[0].astype("float32"))
+
+
+def _time_candidate(run, repeats):
+    _sync(run())  # compile + warmup
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        _sync(run())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def autotune(kernel, signature, candidates, make_run, default, repeats=3):
+    """Pick the best candidate for (kernel, signature).
+
+    Args:
+      kernel: kernel family name, e.g. "flash_attention".
+      signature: hashable shape signature, e.g. "b8_h16_t1024_d64_bf16".
+      candidates: list of JSON-able candidate configs.
+      make_run: candidate -> zero-arg callable executing the kernel once
+        (only called during an online sweep).
+      default: returned when no table entry exists and online tuning is off.
+    Returns: the chosen candidate.
+    """
+    platform = jax.default_backend()
+    key = "{}::{}::{}".format(platform, kernel, signature)
+    if key in _MEMO:
+        return _MEMO[key]
+    bundled, user = _tables()
+    for table in (user, bundled):
+        if key in table:
+            chosen = table[key]["choice"]
+            _MEMO[key] = chosen
+            return chosen
+    if not (online_enabled() and platform == "tpu" and len(candidates) > 1):
+        _MEMO[key] = default
+        return default
+
+    results = []
+    errors = []
+    for cand in candidates:
+        try:
+            dt = _time_candidate(make_run(cand), repeats)
+        except Exception as e:  # candidate may not fit VMEM — skip it
+            errors.append(str(e))
+            continue
+        results.append((dt, cand))
+    if not results:
+        if errors:
+            # The user asked for tuning and got none — say so instead of
+            # silently memoizing the default.
+            import warnings
+            warnings.warn(
+                "autotune({}, {}): all {} candidates failed (first error: "
+                "{}); using default {}".format(kernel, signature,
+                                               len(candidates), errors[0],
+                                               default))
+        _MEMO[key] = default
+        return default
+    best_dt, best = min(results, key=lambda r: r[0])
+    _MEMO[key] = best
+    path = _user_cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        user = _load(path)
+        user[key] = {"choice": best, "seconds": best_dt,
+                     "candidates_timed": len(results)}
+        with open(path, "w") as f:
+            json.dump(user, f, indent=1, sort_keys=True)
+        global _USER
+        _USER = user
+    except OSError:
+        pass
+    return best

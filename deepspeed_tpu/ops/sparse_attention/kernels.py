@@ -356,14 +356,6 @@ def _make_fn(fwd_lut, bwd_lut, blk, scale, causal, has_kpm, has_bias,
         dbias = None
         if bias is not None:
             f32 = jnp.float32
-            s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(f32), k.astype(f32),
-                           preferred_element_type=f32) * scale
-            if kpm is not None:
-                kpm_b = kpm.astype(f32)[:, None, None, :]
-                s = s * kpm_b if kpm_mode == 'mul' else s + kpm_b
-            s_pre_bias = s
-            bias_f = bias.astype(f32)
-            s = s * bias_f if bias_mode == 'mul' else s + bias_f
             # layout block mask (from the LUT: listed kv-block columns),
             # then the causal mask — matching _apply_masks exactly.
             nq = t // blk
@@ -372,19 +364,40 @@ def _make_fn(fwd_lut, bwd_lut, blk, scale, causal, has_kpm, has_bias,
                 for i_ in range(nq):
                     cols = fwd_lut[h_, i_]
                     valid_blocks[h_, i_, cols[cols >= 0]] = True
-            valid = jnp.asarray(np.repeat(np.repeat(
-                valid_blocks, blk, axis=1), blk, axis=2))[None]
+            valid_np = np.repeat(np.repeat(valid_blocks, blk, axis=1),
+                                 blk, axis=2)
             if causal:
                 pos = np.arange(t)
-                valid = valid & jnp.asarray(
-                    pos[:, None] >= pos[None, :])[None, None]
-            s = jnp.where(valid, s, NEG_INF)
-            p = jnp.exp(s - lse.astype(f32))
-            dp = jnp.einsum("bhqd,bhkd->bhqk", do.astype(f32),
-                            v.astype(f32), preferred_element_type=f32)
-            dS = p * (dp - delta.astype(f32))
-            dbias = dS if bias_mode != 'mul' else dS * s_pre_bias
-            dbias = jnp.where(valid, dbias, 0.0).astype(bias.dtype)
+                valid_np = valid_np & (pos[:, None] >= pos[None, :])[None]
+            kpm_b = (kpm.astype(f32)[:, None, :]
+                     if kpm is not None else None)
+
+            def per_head(args):
+                # One head at a time: peak temporaries are [B,T,T], not
+                # [B,H,T,T] — the dense reconstruction must not multiply
+                # backward memory H-fold in the long-sequence regime this
+                # kernel exists for.
+                q_h, k_h, v_h, do_h, lse_h, delta_h, bias_h, valid_h = args
+                s = jnp.einsum("bqd,bkd->bqk", q_h.astype(f32),
+                               k_h.astype(f32),
+                               preferred_element_type=f32) * scale
+                if kpm_b is not None:
+                    s = s * kpm_b if kpm_mode == 'mul' else s + kpm_b
+                s_pre_bias = s
+                bias_f = bias_h.astype(f32)
+                s = s * bias_f if bias_mode == 'mul' else s + bias_f
+                s = jnp.where(valid_h[None], s, NEG_INF)
+                p = jnp.exp(s - lse_h.astype(f32))
+                dp = jnp.einsum("bqd,bkd->bqk", do_h.astype(f32),
+                                v_h.astype(f32), preferred_element_type=f32)
+                dS = p * (dp - delta_h.astype(f32))
+                out = dS if bias_mode != 'mul' else dS * s_pre_bias
+                return jnp.where(valid_h[None], out, 0.0).astype(bias.dtype)
+
+            swap = lambda x: jnp.swapaxes(x, 0, 1)  # [B,H,...] -> [H,B,...]
+            dbias = jnp.swapaxes(jax.lax.map(per_head, (
+                swap(q), swap(k), swap(v), swap(do), swap(lse), swap(delta),
+                swap(bias), jnp.asarray(valid_np))), 0, 1)
         return dq, dk, dv, dkpm, dbias
 
     attend.defvjp(attend_fwd, attend_bwd)

@@ -193,11 +193,12 @@ class VariableSparsityConfig(SparsityConfig):
         # Unlike the reference (which consumes python's global `random`), the
         # random pattern is seedable so layouts are reproducible trace-time
         # constants — required for jit cache stability across processes.
-        # seed=None still gets ONE concrete seed here: default_rng(None)
-        # would draw fresh entropy on every reseed and break the repeated-
-        # make_layout invariant below.
-        self._seed = seed if seed is not None else \
-            int(np.random.default_rng().integers(2 ** 31))
+        # seed=None still gets ONE concrete, PROCESS-INDEPENDENT seed:
+        # default_rng(None) would draw fresh entropy per call (breaking the
+        # repeated-make_layout invariant) and per process (every host must
+        # trace the SAME layout — divergent patterns with allreduced grads
+        # would silently corrupt multi-host training).
+        self._seed = seed if seed is not None else 0x5eed
         self._rng = np.random.default_rng(self._seed)
 
     def set_random_layout(self, h, layout):
@@ -284,8 +285,8 @@ class BigBirdSparsityConfig(SparsityConfig):
         self.num_random_blocks = num_random_blocks
         self.num_sliding_window_blocks = num_sliding_window_blocks
         self.num_global_blocks = num_global_blocks
-        self._seed = seed if seed is not None else \
-            int(np.random.default_rng().integers(2 ** 31))
+        # Process-independent default seed (see VariableSparsityConfig).
+        self._seed = seed if seed is not None else 0x5eed
         self._rng = np.random.default_rng(self._seed)
 
     def set_random_layout(self, h, layout):

@@ -422,8 +422,66 @@ def _flash_attention_bwd(scale, causal, block_q, block_k, res, g):
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
+def _autotuned_blocks(q, k, v, causal, default_q, default_k):
+    """Per-shape tile selection via the autotuner (the reference sweeps
+    cublas algos per shape at layer creation, gemm_test.h:27,141).
+
+    Online sweeps need CONCRETE arrays to execute — when q is a tracer
+    (flash_attention invoked inside an enclosing jit, the engine's normal
+    path), only the bundled/user tables are consulted. Populate the table
+    by calling flash_attention eagerly on the target shapes with
+    DS_TPU_AUTOTUNE=1 (mirroring the reference, which also sweeps at layer
+    creation, not per step)."""
+    import jax.core
+
+    from deepspeed_tpu.ops import autotuner
+
+    b, h, t_q, d = q.shape
+    t_kv = k.shape[2]
+    sig = "b{}_h{}_tq{}_tkv{}_d{}_{}_c{}".format(
+        b, h, t_q, t_kv, d, q.dtype.name, int(bool(causal)))
+    default = [min(default_q, t_q), min(default_k, t_kv)]
+    traced = any(isinstance(x, jax.core.Tracer) for x in (q, k, v))
+    if traced:
+        cands = []  # table lookup only; sweeps cannot run during a trace
+    else:
+        cands = sorted({(min(bq, t_q), min(bk, t_kv))
+                        for bq in (256, 512, 1024) for bk in (512, 1024)
+                        if t_q % min(bq, t_q) == 0
+                        and t_kv % min(bk, t_kv) == 0})
+        cands = [list(c) for c in cands]
+
+    def make_run(cand):
+        bq, bk = cand
+        reps = 10  # amortize dispatch/RTT: kernel time must dominate
+
+        def fwd_bwd(x, y, z):
+            eps = jnp.asarray(1e-7, x.dtype)  # nonzero: keeps grads live
+
+            def once(carry, _):
+                x_, y_, z_ = carry
+                g = jax.grad(lambda a, b_, c: _flash_attention(
+                    a, b_, c, None, 1.0 / d ** 0.5, bool(causal), bq, bk
+                ).astype(jnp.float32).sum(), argnums=(0, 1, 2))(x_, y_, z_)
+                return (x_ + g[0] * eps, y_ + g[1] * eps,
+                        z_ + g[2] * eps), None
+
+            (x, y, z), _ = jax.lax.scan(once, (x, y, z), None, length=reps)
+            return x
+
+        jitted = jax.jit(fwd_bwd)
+
+        def run():
+            return jitted(q, k, v)
+        return run
+
+    choice = autotuner.autotune(
+        "flash_attention", sig, cands, make_run, default=default)
+    return int(choice[0]), int(choice[1])
+
+
 def flash_attention(q, k, v, mask=None, causal=False, scale=None,
-                    block_q=1024, block_k=1024):
+                    block_q=None, block_k=None):
     """Fused (flash) multi-head attention.
 
     Args:
@@ -433,15 +491,21 @@ def flash_attention(q, k, v, mask=None, causal=False, scale=None,
         convention (csrc/transformer/softmax_kernels.cu attn_softmax).
       causal: apply a causal (autoregressive) mask.
       scale: score scale; default 1/sqrt(D).
-      block_q, block_k: VMEM tile sizes. Defaults tuned on v5e (GPT-2 355M
-        shapes, d=64): 1024x1024 beats dense XLA attention 2.1x at T=1024
-        fwd+bwd and 3.0x at T=2048.
+      block_q, block_k: VMEM tile sizes. Default (None) consults the
+        per-shape autotuner table (ops/autotuner.py); its fallback 1024x1024
+        was tuned on v5e (GPT-2 355M shapes, d=64): 2.1x over dense XLA
+        attention at T=1024 fwd+bwd, 3.0x at T=2048.
     Returns: [B, H, T, D] in q.dtype.
     """
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     t_q, t_kv = q.shape[2], k.shape[2]
+    if block_q is None and block_k is None and not _interpret():
+        block_q, block_k = _autotuned_blocks(q, k, v, causal, 1024, 1024)
+    else:
+        block_q = block_q if block_q is not None else 1024
+        block_k = block_k if block_k is not None else 1024
     block_q = min(int(block_q), t_q)
     block_k = min(int(block_k), t_kv)
     if t_q % block_q or t_kv % block_k:

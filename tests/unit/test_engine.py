@@ -301,6 +301,52 @@ def test_elastic_zero_checkpoint_repartition(tmp_path, eight_devices):
     assert np.isfinite(losses).all()
 
 
+def test_multi_output_model():
+    """Multi-loss models (reference tests/unit/test_multi_output_model.py):
+    the TPU engine's convention is out[0] = the scalar to differentiate, so
+    a weighted multi-loss model returns (total, loss_a, loss_b) — training
+    minimizes the weighted total while the per-task losses ride along as
+    aux outputs."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class MultiOutputModel(nn.Module):
+        hidden_dim: int = 8
+
+        @nn.compact
+        def __call__(self, xa, ya, xb, yb):
+            dense = nn.Dense(self.hidden_dim, use_bias=False)
+
+            def ce(x, y):
+                logp = nn.log_softmax(dense(x))
+                return -jnp.mean(
+                    jnp.take_along_axis(logp, y[..., None], axis=-1))
+
+            loss_a, loss_b = ce(xa, ya), ce(xb, yb)
+            return 1.0 * loss_a + 0.5 * loss_b, loss_a, loss_b
+
+    engine, _, _, _ = deepspeed.initialize(
+        model=MultiOutputModel(),
+        config_params=base_config(gradient_accumulation_steps=2,
+                                  train_batch_size=16))
+    rng = np.random.RandomState(0)
+    xa = rng.randn(4, 8).astype(np.float32)
+    xb = rng.randn(4, 8).astype(np.float32)
+    ya = rng.randint(0, 8, size=(4,))
+    yb = rng.randint(0, 8, size=(4,))
+    totals = []
+    for _ in range(8):  # 2 micro-steps per optimizer step (gas=2)
+        total, la, lb = engine(xa, ya, xb, yb)
+        np.testing.assert_allclose(float(total),
+                                   1.0 * float(la) + 0.5 * float(lb),
+                                   rtol=1e-5)
+        engine.backward(total)
+        engine.step()
+        totals.append(float(total))
+    assert engine.global_steps == 4  # gas=2: half as many optimizer steps
+    assert totals[-1] < totals[0]
+
+
 def test_dataloader_integration():
     class DS:
         def __len__(self):
