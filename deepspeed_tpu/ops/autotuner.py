@@ -93,14 +93,25 @@ def autotune(kernel, signature, candidates, make_run, default, repeats=3):
     key = "{}::{}::{}".format(platform, kernel, signature)
     if key in _MEMO:
         return _MEMO[key]
+    multiproc = jax.process_count() > 1
     bundled, user = _tables()
-    for table in (user, bundled):
+    # Multi-controller runs consult ONLY the package-bundled table: every
+    # host ships the same file, so every host traces the same tiles. The
+    # per-host user cache (and per-host sweeps) could diverge across hosts
+    # and compile different executables.
+    tables = (bundled,) if multiproc else (user, bundled)
+    for table in tables:
         if key in table:
             chosen = table[key]["choice"]
             _MEMO[key] = chosen
             return chosen
-    if not (online_enabled() and platform == "tpu" and len(candidates) > 1):
-        _MEMO[key] = default
+    if not (online_enabled() and platform == "tpu" and len(candidates) > 1
+            and not multiproc):
+        if not online_enabled():
+            # With tuning off the answer can never change — memoize. With
+            # tuning ON but no runnable candidates (traced call), leave the
+            # memo empty so a later EAGER call can still run the sweep.
+            _MEMO[key] = default
         return default
 
     results = []
@@ -132,8 +143,10 @@ def autotune(kernel, signature, candidates, make_run, default, repeats=3):
         user = _load(path)
         user[key] = {"choice": best, "seconds": best_dt,
                      "candidates_timed": len(results)}
-        with open(path, "w") as f:
+        tmp = "{}.tmp.{}".format(path, os.getpid())
+        with open(tmp, "w") as f:
             json.dump(user, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)  # atomic: concurrent writers can't corrupt
         global _USER
         _USER = user
     except OSError:

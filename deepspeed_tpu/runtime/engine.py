@@ -61,11 +61,34 @@ from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTime
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500000000
 
+# Debug cross-check toggle (reference stage2.py:23-25 pg_correctness_test,
+# which forces deterministic fp32 allreduce so partitioned gradients can be
+# compared against unpartitioned ones). TPU analog: with the flag on, every
+# training fwd+bwd ALSO runs an unconstrained program (no ZeRO gradient
+# sharding constraints, fully replicated batch) and asserts the sharded
+# path produced the same gradients — catching partitioner/constraint bugs
+# at the step they occur. Debug-only: doubles compute per step.
+pg_correctness_test = False
+
 SUMMARY_WRITER_DIR_NAME = "JobId"
 
 
-def split_half_float_double_csr(tensors):  # parity helper, unused on TPU
-    return tensors
+def split_half_float_double_csr(tensors):
+    """Bucket tensors by dtype with CSR tensors in their own bucket
+    (reference engine.py:54-66, which keys off torch tensor type strings).
+    TPU form: (dtype name, bucket) pairs over jnp dtypes + CSRTensor."""
+    from deepspeed_tpu.runtime.csr_tensor import CSRTensor
+
+    order = [jnp.bfloat16.dtype.name, jnp.float16.dtype.name,
+             jnp.float32.dtype.name, jnp.float64.dtype.name,
+             CSRTensor.type()]
+    groups = {}
+    for t in tensors:  # single pass
+        key = CSRTensor.type() if isinstance(t, CSRTensor) \
+            else jnp.asarray(t).dtype.name
+        groups.setdefault(key if key in order else "other", []).append(t)
+    return [(dtype, groups[dtype]) for dtype in order + ["other"]
+            if dtype in groups]
 
 
 class DeepSpeedEngine(object):
@@ -845,8 +868,12 @@ class DeepSpeedEngine(object):
             else jnp.float32(1.0)
         fwd_bwd = self._get_fwd_bwd(len(inputs), static_kwargs,
                                     traced_kwargs.keys(), self.training)
+        step_rng = self._next_rng()
         out, grads = fwd_bwd(self.params, inputs, traced_kwargs,
-                             self._next_rng(), scale)
+                             step_rng, scale)
+        if pg_correctness_test and self.training:
+            self._pg_correctness_check(inputs, static_kwargs, traced_kwargs,
+                                       step_rng, scale, grads)
         if getattr(self, "flops_profiler", None) is not None and \
                 self.flops_profiler.started:
             # Exact program cost from XLA (fwd+bwd in one program); the
@@ -872,6 +899,44 @@ class DeepSpeedEngine(object):
             self._stop_flops_profiler()
 
         return out
+
+    def _pg_correctness_check(self, inputs, static_kwargs, traced_kwargs,
+                              rng, scale, sharded_grads):
+        """Cross-check sharded-path gradients against an INDEPENDENT
+        reference program: fp32 compute, no ZeRO sharding constraints,
+        fully replicated data (reference pg_correctness_test,
+        stage2.py:23-25: deterministic fp32 allreduce so partitioned grads
+        can be verified against unpartitioned ones). Forcing fp32 keeps the
+        reference program distinct even at stage 0/1, where the sharded
+        path has no constraint either — comparing a program against itself
+        would be vacuous. Raises on mismatch."""
+        saved_constraint = self._grad_constraint
+        saved_dtype = self.compute_dtype
+        self._grad_constraint = None
+        self.compute_dtype = jnp.float32
+        try:
+            ref_fn = self._get_fwd_bwd(len(inputs), static_kwargs,
+                                       traced_kwargs.keys(), True)
+            rep = mesh_lib.replicated(self.mesh)
+            rep_params = jax.device_put(self.params, rep)
+            rep_inputs = jax.device_put(inputs, rep)
+            _, ref_grads = ref_fn(rep_params, rep_inputs, traced_kwargs,
+                                  rng, scale)
+        finally:
+            self._grad_constraint = saved_constraint
+            self.compute_dtype = saved_dtype
+        tol = 2e-2 if saved_dtype != jnp.float32 else 1e-4
+        for (path, a), b in zip(
+                jax.tree_util.tree_flatten_with_path(sharded_grads)[0],
+                jax.tree_util.tree_leaves(ref_grads)):
+            a = np.asarray(jax.device_get(a), np.float32)
+            b = np.asarray(jax.device_get(b), np.float32)
+            if not np.allclose(a, b, rtol=tol, atol=tol):
+                raise RuntimeError(
+                    "pg_correctness_test: sharded gradient for {} diverges "
+                    "from the fp32 replicated reference (max abs diff "
+                    "{})".format(jax.tree_util.keystr(path),
+                                 np.abs(a - b).max()))
 
     # --------------------------------------------------------------- backward
 

@@ -139,9 +139,17 @@ class PipelineEngine(DeepSpeedEngine):
         return self.pipe_module.stage_owner(idx)
 
     def _place(self, tree, stage_id):
-        """Replicate a pytree (params, opt state) over a stage's submesh."""
-        sh = NamedSharding(self.stage_meshes[stage_id], P())
-        return jax.device_put(tree, sh)
+        """Place a pytree (params, opt state) on a stage's submesh:
+        replicated, except leaves matching the tensor-parallel rules when
+        the stage mesh has a 'model' axis — PP x TP composition (the
+        reference's slice-group partitioning, pipe/engine.py:504-534)."""
+        mesh = self.stage_meshes[stage_id]
+        if mesh.shape.get(mesh_lib.MODEL_AXIS, 1) > 1 and tree is not None:
+            sh, _, _ = mesh_lib.zero_shardings(
+                mesh, tree, 0,
+                tp_rules=getattr(self.pipe_module, "tp_rules", None))
+            return jax.device_put(tree, sh)
+        return jax.device_put(tree, NamedSharding(mesh, P()))
 
     def _place_batch(self, tree, stage_id):
         """Shard batch-leading arrays over the stage's 'data' axis; leaves

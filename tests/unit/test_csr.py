@@ -67,6 +67,20 @@ def test_sparse_grad_exchange_matches_psum():
     np.testing.assert_allclose(sparse, dense, rtol=1e-6, atol=1e-7)
 
 
+def test_split_half_float_double_csr():
+    """Dtype bucketing with CSR tensors separated (reference
+    engine.py:54-66)."""
+    from deepspeed_tpu.runtime.engine import split_half_float_double_csr
+
+    csr = CSRTensor(jnp.zeros((4, 2)).at[1].set(1.0))
+    tensors = [jnp.zeros((2,), jnp.bfloat16), jnp.zeros((2,), jnp.float32),
+               csr, jnp.ones((3,), jnp.float32)]
+    buckets = dict(split_half_float_double_csr(tensors))
+    assert len(buckets["bfloat16"]) == 1
+    assert len(buckets["float32"]) == 2
+    assert buckets[CSRTensor.type()] == [csr]
+
+
 def test_engine_sparse_embedding_grad_parity():
     """Engine-integrated sparse embedding-grad DP (reference
     engine.py:180-185,1186-1242): training with sparse_gradients=true must

@@ -301,6 +301,24 @@ def test_elastic_zero_checkpoint_repartition(tmp_path, eight_devices):
     assert np.isfinite(losses).all()
 
 
+def test_pg_correctness_toggle(eight_devices):
+    """reference stage2.py:23-25 pg_correctness_test analogue: with the
+    debug toggle on, every training step cross-checks the sharded-path
+    gradients against a replicated unconstrained program."""
+    from deepspeed_tpu.runtime import engine as engine_mod
+
+    model = SimpleModel(hidden_dim=16)
+    cfg = base_config(bf16={"enabled": True},
+                      zero_optimization={"stage": 2})
+    engine, _, _, _ = deepspeed.initialize(model=model, config_params=cfg)
+    engine_mod.pg_correctness_test = True
+    try:
+        losses = run_steps(engine, steps=3)
+    finally:
+        engine_mod.pg_correctness_test = False
+    assert np.isfinite(losses).all()
+
+
 def test_multi_output_model():
     """Multi-loss models (reference tests/unit/test_multi_output_model.py):
     the TPU engine's convention is out[0] = the scalar to differentiate, so
