@@ -910,6 +910,12 @@ class DeepSpeedEngine(object):
         reference program distinct even at stage 0/1, where the sharded
         path has no constraint either — comparing a program against itself
         would be vacuous. Raises on mismatch."""
+        if bool(jax.device_get(jit_has_overflow(sharded_grads))):
+            # fp16 overflow step: by design recoverable — the step path
+            # skips it and shrinks the scale; inf/nan grads can never match
+            # the fp32 reference, so checking would turn recovery into a
+            # crash.
+            return
         saved_constraint = self._grad_constraint
         saved_dtype = self.compute_dtype
         self._grad_constraint = None

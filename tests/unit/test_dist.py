@@ -23,8 +23,15 @@ def test_single_process_init_is_noop(monkeypatch):
 
 def test_mpi_discovery_sets_env(monkeypatch):
     monkeypatch.setattr(dist, "_initialized", False)
-    for k in ("RANK", "WORLD_SIZE", "LOCAL_RANK", "MASTER_ADDR"):
+    for k in ("RANK", "WORLD_SIZE", "LOCAL_RANK", "MASTER_ADDR",
+              "MASTER_PORT"):
         monkeypatch.delenv(k, raising=False)
+        # mpi_discovery writes os.environ directly; register each key with
+        # monkeypatch so the writes are rolled back after the test (a
+        # leaked WORLD_SIZE=4 would make a later init_distributed try a
+        # real 4-process rendezvous).
+        monkeypatch.setenv(k, "sentinel")
+        monkeypatch.delenv(k)
     monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
     monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
     monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_RANK", "1")

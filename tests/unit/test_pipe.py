@@ -186,6 +186,29 @@ def test_pipe_tensor_parallel_composition():
     assert shard.shape[1] * 2 == kern.shape[1]
 
 
+def test_pipe_eval_batch_matches_serial():
+    """InferenceSchedule path: pipelined eval loss == serial eval loss, and
+    eval must not touch parameters (reference pipe/engine.py:320-387)."""
+    import jax
+    gas = 2
+    serial = make_pipeline(num_stages=1, gas=gas)
+    pipe = make_pipeline(num_stages=2, gas=gas)
+    data = batches(2, gas)
+    # one training step so both have identical (seeded) trained params
+    serial.train_batch(data_iter=iter(data[:gas]))
+    pipe.train_batch(data_iter=iter(data[:gas]))
+
+    params_before = [jax.tree_util.tree_leaves(p)[0].copy()
+                     for p in pipe.layer_params if p is not None]
+    l_serial = serial.eval_batch(data_iter=iter(data[gas:2 * gas]))
+    l_pipe = pipe.eval_batch(data_iter=iter(data[gas:2 * gas]))
+    np.testing.assert_allclose(l_pipe, l_serial, rtol=1e-4)
+    params_after = [jax.tree_util.tree_leaves(p)[0]
+                    for p in pipe.layer_params if p is not None]
+    for a, b in zip(params_before, params_after):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_pipe_engine_rejects_forward():
     engine = make_pipeline(num_stages=2)
     with pytest.raises(RuntimeError):

@@ -76,6 +76,21 @@ def test_tp_loss_parity_vs_data_parallel(eight_devices):
     assert tp_losses[-1] < tp_losses[0]
 
 
+def test_tp_fused_train_batch(eight_devices):
+    """The fused single-program train_batch path must work under TP too:
+    params stay model-sharded through donated in-place updates."""
+    engine, cfg = _make_engine(num_mp=4, num_dp=2)
+    rng = np.random.RandomState(0)
+    losses = []
+    for i in range(3):
+        ids = rng.randint(0, cfg.vocab_size, size=(8, 16))
+        losses.append(float(engine.train_batch(batch=(ids, ids))))
+    assert np.isfinite(losses).all()
+    qkv = engine.params["h_0"]["attn"]["c_attn"]["kernel"]
+    assert qkv.addressable_shards[0].data.shape == \
+        (cfg.n_embd, 3 * cfg.n_embd // 4)
+
+
 def test_tp_composes_with_zero3(eight_devices):
     """ZeRO-3 + TP: a qkv kernel carries BOTH axes — 'model' on its output
     dim and 'data' on another dim — so each device holds 1/(mp*dp)."""
