@@ -910,11 +910,13 @@ class DeepSpeedEngine(object):
         reference program distinct even at stage 0/1, where the sharded
         path has no constraint either — comparing a program against itself
         would be vacuous. Raises on mismatch."""
-        if bool(jax.device_get(jit_has_overflow(sharded_grads))):
-            # fp16 overflow step: by design recoverable — the step path
-            # skips it and shrinks the scale; inf/nan grads can never match
-            # the fp32 reference, so checking would turn recovery into a
-            # crash.
+        if self.loss_scaler is not None and \
+                bool(jax.device_get(jit_has_overflow(sharded_grads))):
+            # fp16 overflow step: by design recoverable — the scaler's step
+            # path skips it and shrinks the scale; inf/nan grads can never
+            # match the fp32 reference, so checking would turn recovery
+            # into a crash. WITHOUT a scaler there is no recovery path, so
+            # non-finite grads fall through to the check and raise.
             return
         saved_constraint = self._grad_constraint
         saved_dtype = self.compute_dtype

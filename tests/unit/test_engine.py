@@ -251,6 +251,43 @@ def test_checkpoint_save_load_roundtrip(tmp_path):
     assert np.isfinite(losses).all()
 
 
+def test_checkpoint_restores_scheduler_and_loss_scaler(tmp_path):
+    """Reference test_checkpointing.py also round-trips LR-scheduler and
+    fp16 loss-scaler state: resumed training must continue the schedule and
+    the dynamic scale, not restart them."""
+    def make():
+        cfg = base_config(
+            fp16={"enabled": True, "initial_scale_power": 8,
+                  "hysteresis": 1},
+            scheduler={"type": "WarmupLR",
+                       "params": {"warmup_min_lr": 0.0,
+                                  "warmup_max_lr": 1e-2,
+                                  "warmup_num_steps": 10}})
+        return deepspeed.initialize(model=SimpleModel(hidden_dim=16),
+                                    config_params=cfg)[0]
+
+    engine = make()
+    run_steps(engine, steps=4)
+    # mutate dynamic-scaler state so restoration is observable
+    engine.loss_scaler.cur_scale /= 4
+    engine.loss_scaler.cur_iter = 17
+    lr_before = engine.get_lr()
+    engine.save_checkpoint(str(tmp_path), tag="sched")
+
+    engine2 = make()
+    x, y = random_batch()
+    engine2(x, y)
+    engine2.load_checkpoint(str(tmp_path))
+    assert engine2.global_steps == 4
+    assert engine2.loss_scaler.cur_scale == engine.loss_scaler.cur_scale
+    assert engine2.loss_scaler.cur_iter == 17
+    assert engine2.get_lr() == lr_before
+    assert engine2.lr_scheduler.state_dict() == \
+        engine.lr_scheduler.state_dict()
+    losses = run_steps(engine2, steps=2)
+    assert np.isfinite(losses).all()
+
+
 def test_checkpoint_zero_files(tmp_path):
     model = SimpleModel(hidden_dim=16)
     cfg = base_config(bf16={"enabled": True},

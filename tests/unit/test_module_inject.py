@@ -107,6 +107,47 @@ def test_replace_transformer_layer_output_matches_hf_forward():
                                rtol=2e-2, atol=2e-3)
 
 
+def test_replace_layer_matches_real_transformers_bert():
+    """Injection against the REAL HuggingFace flax BERT layer (the
+    reference swaps HF BertLayer modules in place, replace_module.py:6-90):
+    params initialized by transformers' own FlaxBertLayer pack into the
+    fused layer and produce the same forward output."""
+    import pytest
+    pytest.importorskip("transformers")
+    from transformers import BertConfig
+    from transformers.models.bert.modeling_flax_bert import FlaxBertLayer
+
+    hf_cfg = BertConfig(hidden_size=32, num_attention_heads=4,
+                        intermediate_size=64, num_hidden_layers=2,
+                        vocab_size=128, max_position_embeddings=64,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+    hf_layer = FlaxBertLayer(config=hf_cfg, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 32))
+    mask = jnp.ones((2, 16))  # HF extends [B, T] itself
+    hf_params = hf_layer.init(jax.random.PRNGKey(0), x, mask, None,
+                              deterministic=True)["params"]
+    hf_out = hf_layer.apply({"params": hf_params}, x, mask, None,
+                            deterministic=True)[0]
+
+    ds_cfg = HFBertConfig()
+    layer, packed = replace_transformer_layer(
+        model=None, params={"encoder": {"layer_0": hf_params}},
+        micro_batch_size=2, bert_config=ds_cfg, fp16=False, training=False,
+        max_seq_length=16)
+    out = layer.apply({"params": packed["encoder"]["layer_0"]}, x,
+                      deterministic=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(hf_out),
+                               rtol=2e-2, atol=2e-3)
+
+    # and the round-trip restores transformers' own layout bitwise
+    restored = revert_transformer_layer(
+        params=packed)["encoder"]["layer_0"]
+    for a, b in zip(jax.tree_util.tree_leaves(hf_params),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_revert_after_replace_identity():
     cfg = HFBertConfig()
     hf = {"m": _hf_layer_params(3, 32, 64)}

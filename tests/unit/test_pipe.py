@@ -198,13 +198,14 @@ def test_pipe_eval_batch_matches_serial():
     serial.train_batch(data_iter=iter(data[:gas]))
     pipe.train_batch(data_iter=iter(data[:gas]))
 
-    params_before = [jax.tree_util.tree_leaves(p)[0].copy()
-                     for p in pipe.layer_params if p is not None]
+    params_before = [np.asarray(leaf).copy()
+                     for p in pipe.layer_params if p is not None
+                     for leaf in jax.tree_util.tree_leaves(p)]
     l_serial = serial.eval_batch(data_iter=iter(data[gas:2 * gas]))
     l_pipe = pipe.eval_batch(data_iter=iter(data[gas:2 * gas]))
     np.testing.assert_allclose(l_pipe, l_serial, rtol=1e-4)
-    params_after = [jax.tree_util.tree_leaves(p)[0]
-                    for p in pipe.layer_params if p is not None]
+    params_after = [leaf for p in pipe.layer_params if p is not None
+                    for leaf in jax.tree_util.tree_leaves(p)]
     for a, b in zip(params_before, params_after):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
