@@ -188,6 +188,15 @@ class BertModel(nn.Module):
         return x, pooled, wte
 
 
+def _chunked_mlm_xent(h, wte, bias, labels, dtype, chunk=2048):
+    """Masked-LM form of the shared chunked tied-decoder loss: -1 labels
+    ignored (the BERT convention, reference tests/unit/modeling.py MLM
+    loss), decoder bias added, mean over masked positions."""
+    from deepspeed_tpu.models.heads import chunked_tied_softmax_xent
+    return chunked_tied_softmax_xent(h, wte, labels, dtype, chunk=chunk,
+                                     bias=bias, ignore_index=-1)
+
+
 class BertForPreTraining(nn.Module):
     """MLM + NSP pretraining heads. Returns the summed loss when labels are
     given (DeepSpeed convention: model output IS the loss), else
@@ -212,25 +221,22 @@ class BertForPreTraining(nn.Module):
                          name="transform_LayerNorm")(h.astype(jnp.float32))
         mlm_bias = self.param("mlm_bias", nn.initializers.zeros,
                               (cfg.vocab_size,), jnp.float32)
-        prediction_logits = h @ wte.T.astype(jnp.float32) + mlm_bias
 
         seq_relationship = nn.Dense(2, dtype=jnp.float32,
                                     name="seq_relationship")(
                                         pooled.astype(jnp.float32))
 
         if masked_lm_labels is None and next_sentence_label is None:
+            prediction_logits = h @ wte.T.astype(jnp.float32) + mlm_bias
             return prediction_logits, seq_relationship
 
         total = 0.0
         if masked_lm_labels is not None:
-            # Positions with label -1 are unmasked (ignored), the BERT
-            # convention (reference tests/unit/modeling.py MLM loss).
-            valid = (masked_lm_labels >= 0).astype(jnp.float32)
-            labels = jnp.maximum(masked_lm_labels, 0)
-            logp = jax.nn.log_softmax(prediction_logits, axis=-1)
-            nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-            total = total + jnp.sum(nll * valid) / jnp.maximum(
-                jnp.sum(valid), 1.0)
+            # Chunked masked-LM loss: the [B, T, V] fp32 logits never
+            # materialize (the GPT-2 head's chunking, gpt2.py:178, with
+            # BERT's -1-ignore labels and decoder bias).
+            total = total + _chunked_mlm_xent(h, wte, mlm_bias,
+                                              masked_lm_labels, cfg.dtype)
         if next_sentence_label is not None:
             logp = jax.nn.log_softmax(seq_relationship, axis=-1)
             nll = -jnp.take_along_axis(

@@ -176,40 +176,10 @@ class GPT2LMHeadModel(nn.Module):
 
 
 def _chunked_softmax_xent(x, wte, labels, dtype, chunk=2048):
-    """Mean token cross-entropy against a tied [V, C] embedding head,
-    computed in `chunk`-token slices so at most chunk*V logits live at once
-    (forward AND backward, via jax.checkpoint)."""
-    b, t, c = x.shape
-    n = b * t
-    xf = x.reshape(n, c)
-    lf = labels.reshape(n)
-    # Small batches: shrink the chunk (rounded to the 128-lane register
-    # width) so padding never multiplies the head-GEMM work.
-    chunk = min(chunk, max(128, -(-n // 128) * 128))
-    pad = (-n) % chunk
-    if pad:
-        xf = jnp.concatenate(
-            [xf, jnp.zeros((pad, c), xf.dtype)], axis=0)
-        lf = jnp.concatenate([lf, jnp.zeros((pad,), lf.dtype)])
-    valid = (jnp.arange(n + pad) < n).astype(jnp.float32)
-    n_chunks = (n + pad) // chunk
-    xc = xf.reshape(n_chunks, chunk, c)
-    lc = lf.reshape(n_chunks, chunk)
-    vc = valid.reshape(n_chunks, chunk)
-    w = wte.astype(dtype)
-
-    @jax.checkpoint
-    def one(args):
-        xi, li, vi = args
-        logits = jax.lax.dot_general(
-            xi.astype(dtype), w, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [chunk, V] fp32
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, li[:, None], axis=1)[:, 0]
-        return jnp.sum((lse - gold) * vi)
-
-    total = jnp.sum(jax.lax.map(one, (xc, lc, vc)))
-    return total / n
+    """Causal-LM form of the shared chunked tied-decoder loss (every token
+    supervised; see models/heads.py)."""
+    from deepspeed_tpu.models.heads import chunked_tied_softmax_xent
+    return chunked_tied_softmax_xent(x, wte, labels, dtype, chunk=chunk)
 
 
 def create_model(config=None, **kw):

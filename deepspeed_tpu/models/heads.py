@@ -1,0 +1,67 @@
+"""Chunked tied-decoder cross-entropy — the shared LM-head loss.
+
+One helper serves both heads that would otherwise materialize [tokens, V]
+fp32 logits: GPT-2's causal LM head (every token supervised) and BERT's
+masked-LM head (-1-ignore labels, decoder bias). Logits are computed in
+`chunk`-token slices, forward AND backward (jax.checkpoint), so at most
+chunk*V live at once — the memory trick that lets batch 8 x 1024 GPT-2
+train without remat (reference analogue: the fused transformer's
+gelu/attn checkpoint modes trade memory the same way,
+csrc/transformer/ds_transformer_cuda.cpp normalize_invertible family).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def chunked_tied_softmax_xent(x, wte, labels, dtype, chunk=2048, bias=None,
+                              ignore_index=None):
+    """Mean token cross-entropy against a tied [V, C] embedding decoder.
+
+    Args:
+      x: [B, T, C] final hidden states.
+      wte: [V, C] tied embedding table.
+      labels: [B, T] int targets; positions equal to ``ignore_index`` (when
+        given) are excluded from both numerator and denominator.
+      dtype: GEMM input dtype (fp32 accumulation regardless).
+      chunk: tokens per slice; clamped to the padded token count.
+      bias: optional [V] decoder bias (BERT's mlm_bias).
+    Returns: scalar mean loss over supervised tokens.
+    """
+    b, t, c = x.shape
+    n = b * t
+    xf = x.reshape(n, c)
+    lf = labels.reshape(n)
+    # Small batches: shrink the chunk (rounded to the 128-lane register
+    # width) so padding never multiplies the head-GEMM work.
+    chunk = min(chunk, max(128, -(-n // 128) * 128))
+    pad = (-n) % chunk
+    if pad:
+        xf = jnp.concatenate([xf, jnp.zeros((pad, c), xf.dtype)], axis=0)
+        lf = jnp.concatenate([lf, jnp.zeros((pad,), lf.dtype)])
+    valid = (jnp.arange(n + pad) < n)
+    if ignore_index is not None:
+        valid = valid & (lf != ignore_index)
+    valid = valid.astype(jnp.float32)
+    li = jnp.maximum(lf, 0)
+    n_chunks = (n + pad) // chunk
+    xc = xf.reshape(n_chunks, chunk, c)
+    lc = li.reshape(n_chunks, chunk)
+    vc = valid.reshape(n_chunks, chunk)
+    w = wte.astype(dtype)
+    bias_f = bias.astype(jnp.float32) if bias is not None else None
+
+    @jax.checkpoint
+    def one(args):
+        xi, li_, vi = args
+        logits = jax.lax.dot_general(
+            xi.astype(dtype), w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [chunk, V] fp32
+        if bias_f is not None:
+            logits = logits + bias_f
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, li_[:, None], axis=1)[:, 0]
+        return jnp.sum((lse - gold) * vi)
+
+    total = jnp.sum(jax.lax.map(one, (xc, lc, vc)))
+    return total / jnp.maximum(jnp.sum(valid), 1.0)

@@ -51,6 +51,41 @@ def test_bert_attention_mask_zeroes_padding_influence():
                                rtol=2e-2, atol=2e-2)
 
 
+def test_chunked_mlm_loss_matches_dense():
+    """The chunked masked-LM loss (logits never materialized) must equal
+    the naive dense log_softmax loss, value AND gradient, including the
+    -1-ignore convention and a chunk-padding tail."""
+    from deepspeed_tpu.models.bert import _chunked_mlm_xent
+
+    rng = np.random.RandomState(0)
+    b, t, c, v = 2, 9, 8, 32  # t chosen so b*t is NOT a multiple of 128
+    h = jnp.asarray(rng.randn(b, t, c).astype(np.float32))
+    wte = jnp.asarray(rng.randn(v, c).astype(np.float32))
+    bias = jnp.asarray(rng.randn(v).astype(np.float32))
+    labels = rng.randint(0, v, size=(b, t))
+    labels[rng.rand(b, t) > 0.4] = -1  # most positions unmasked
+    labels = jnp.asarray(labels)
+
+    def dense(h, wte, bias):
+        logits = h.astype(jnp.float32) @ wte.T + bias
+        valid = (labels >= 0).astype(jnp.float32)
+        li = jnp.maximum(labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, li[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+    def chunked(h, wte, bias):
+        return _chunked_mlm_xent(h, wte, bias, labels, jnp.float32, chunk=4)
+
+    np.testing.assert_allclose(float(chunked(h, wte, bias)),
+                               float(dense(h, wte, bias)), rtol=1e-5)
+    g_c = jax.grad(chunked, argnums=(0, 1, 2))(h, wte, bias)
+    g_d = jax.grad(dense, argnums=(0, 1, 2))(h, wte, bias)
+    for a, b_ in zip(g_c, g_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-6)
+
+
 def test_bert_pretraining_trains_under_engine():
     cfg = BertConfig.tiny(hidden_dropout_prob=0.0,
                           attention_probs_dropout_prob=0.0)

@@ -36,6 +36,39 @@ def test_duplicate_host_raises(tmp_path):
         dsrun.fetch_hostfile(str(hf))
 
 
+def test_launcher_end_to_end_spawn(tmp_path):
+    """Full CLI path: `bin/deepspeed script.py` → runner → per-node launch
+    → user subprocess with the coordinator env set (reference
+    launch.py:101-126 spawn contract) — exercised with a real subprocess."""
+    import os
+    import subprocess
+    import sys
+
+    script = tmp_path / "train_stub.py"
+    script.write_text(
+        "import json, os, sys\n"
+        "out = {k: os.environ.get(k) for k in\n"
+        "       ('MASTER_ADDR', 'MASTER_PORT', 'RANK', 'WORLD_SIZE',\n"
+        "        'LOCAL_RANK')}\n"
+        "out['argv'] = sys.argv[1:]\n"
+        "print('STUB' + json.dumps(out))\n")
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ, PYTHONPATH=repo + os.pathsep +
+               os.environ.get("PYTHONPATH", ""), JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bin", "deepspeed"),
+         "--master_port", "29871", str(script), "--flag", "v"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stderr
+    line = [l for l in proc.stdout.splitlines() if l.startswith("STUB")][0]
+    got = json.loads(line[len("STUB"):])
+    assert got["MASTER_PORT"] == "29871"
+    assert got["RANK"] == "0" and got["WORLD_SIZE"] == "1"
+    assert got["LOCAL_RANK"] == "0"
+    assert got["argv"] == ["--local_rank=0", "--flag", "v"]
+
+
 def _pool():
     import collections
     return collections.OrderedDict([("worker-0", 4), ("worker-1", 4)])
