@@ -5,7 +5,8 @@ Pallas kernels — no build step, registered for ds_report parity. Host ops
 (cpu_adam, utils) are C++ compiled at first use.
 """
 
-from deepspeed_tpu.op_builder.builder import (CPUAdamBuilder, OpBuilder,
+from deepspeed_tpu.op_builder.builder import (CPUAdamBuilder, CPULambBuilder,
+                                              OpBuilder, SparseLutBuilder,
                                               UtilsBuilder, csrc_path)
 
 
@@ -34,6 +35,8 @@ def _pallas(name, module_path):
 
 ALL_OPS = {
     "cpu_adam": CPUAdamBuilder,
+    "cpu_lamb": CPULambBuilder,
+    "sparse_lut": SparseLutBuilder,
     "utils": UtilsBuilder,
     "fused_adam": _pallas("fused_adam", "deepspeed_tpu.ops.adam.fused_adam"),
     "fused_lamb": _pallas("fused_lamb", "deepspeed_tpu.ops.lamb.fused_lamb"),

@@ -142,6 +142,18 @@ _c_u16_p = ctypes.POINTER(ctypes.c_uint16)
 _c_long_p = ctypes.POINTER(ctypes.c_long)
 
 
+def as_c_float(arr):
+    """numpy fp32 array -> C float* (shared by the ctypes op wrappers)."""
+    return arr.ctypes.data_as(_c_float_p)
+
+
+def as_c_u16(arr):
+    """numpy uint16 array -> C uint16_t*; None -> NULL."""
+    if arr is None:
+        return _c_u16_p()
+    return arr.ctypes.data_as(_c_u16_p)
+
+
 class CPUAdamBuilder(OpBuilder):
     """Builds the host Adam op (reference op_builder/cpu_adam.py)."""
 
@@ -167,6 +179,54 @@ class CPUAdamBuilder(OpBuilder):
         cdll.ds_l2_norm_sq.restype = ctypes.c_double
         cdll.ds_scale.argtypes = [ctypes.c_long, ctypes.c_float, _c_float_p]
         cdll.ds_scale.restype = None
+        return cdll
+
+
+class CPULambBuilder(OpBuilder):
+    """Builds the host LAMB op (reference builds LAMB as a CUDA op,
+    op_builder/fused_lamb.py; the host variant makes Lamb + cpu_offload
+    compose on the TPU-VM tier)."""
+
+    BUILD_VAR = "DS_BUILD_CPU_LAMB"
+    NAME = "cpu_lamb"
+
+    def __init__(self):
+        super().__init__(self.NAME)
+
+    def sources(self):
+        return [csrc_path("lamb", "cpu_lamb.cpp")]
+
+    def _bind(self, cdll):
+        cdll.ds_lamb_step.argtypes = [
+            ctypes.c_long, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_int, ctypes.c_float,
+            ctypes.c_float, ctypes.c_long] + [_c_float_p] * 5 + [_c_u16_p]
+        cdll.ds_lamb_step.restype = ctypes.c_float
+        return cdll
+
+
+class SparseLutBuilder(OpBuilder):
+    """Builds the layout->LUT lowering op (reference
+    op_builder/sparse_attn.py builds the OpenMP sdd_segment load balancer,
+    csrc/sparse_attention/utils.cpp:119)."""
+
+    BUILD_VAR = "DS_BUILD_SPARSE_ATTN"
+    NAME = "sparse_lut"
+
+    def __init__(self):
+        super().__init__(self.NAME)
+
+    def sources(self):
+        return [csrc_path("sparse_attention", "lut.cpp")]
+
+    def _bind(self, cdll):
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        dims = [ctypes.c_long, ctypes.c_long, ctypes.c_long]
+        cdll.ds_lut_max_degree.argtypes = dims + [i32p, ctypes.c_int]
+        cdll.ds_lut_max_degree.restype = ctypes.c_long
+        cdll.ds_build_lut.argtypes = dims + [i32p, ctypes.c_int,
+                                             ctypes.c_long, i32p]
+        cdll.ds_build_lut.restype = None
         return cdll
 
 

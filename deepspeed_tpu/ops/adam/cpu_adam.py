@@ -15,17 +15,9 @@ available (the OpBuilder contract: is_compatible() gates, never crashes).
 import numpy as np
 
 from deepspeed_tpu.op_builder import CPUAdamBuilder
+from deepspeed_tpu.op_builder.builder import as_c_float as _as_c
+from deepspeed_tpu.op_builder.builder import as_c_u16 as _as_c_u16
 from deepspeed_tpu.utils.logging import logger
-
-
-def _as_c(arr):
-    import ctypes
-    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
-
-
-def _as_c_u16(arr):
-    import ctypes
-    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
 
 
 class DeepSpeedCPUAdam(object):

@@ -1,0 +1,194 @@
+"""DeepSpeedCPULamb — host-memory LAMB for the ZeRO-Offload tier.
+
+The reference ships LAMB only as a CUDA op (ops/lamb/fused_lamb.py:12,
+csrc/lamb/fused_lamb_cuda_kernel.cu) and its offload tier is Adam-only
+(engine.py:577-617 decision matrix). On the TPU-VM tier the host runs the
+offloaded update either way, so LAMB gets the same C++ OpenMP treatment as
+cpu_adam: per-tensor trust ratios computed in one parallel pass
+(csrc/lamb/cpu_lamb.cpp), with the fused bf16 downcast variant.
+
+Because LAMB's trust ratio is a PER-TENSOR statistic, the flat-buffer step
+takes an optional ``segments`` list of (offset, size) spans — each span is
+one parameter tensor and gets its own ratio. Without segments the whole
+span is treated as a single tensor (matching FusedLamb called on one leaf).
+
+Falls back to a vectorized numpy implementation when no C++ toolchain is
+available (the OpBuilder contract: is_compatible() gates, never crashes).
+"""
+
+import numpy as np
+
+from deepspeed_tpu.op_builder import CPULambBuilder
+from deepspeed_tpu.op_builder.builder import as_c_float, as_c_u16
+from deepspeed_tpu.utils.logging import logger
+
+
+def _bf16_rne(x):
+    """fp32 -> bf16 bits with round-to-nearest-even (matches the C++
+    float_to_bf16, csrc/lamb/cpu_lamb.cpp)."""
+    bits = np.ascontiguousarray(x, np.float32).view(np.uint32)
+    nan = (bits & np.uint32(0x7fffffff)) > np.uint32(0x7f800000)
+    lsb = (bits >> np.uint32(16)) & np.uint32(1)
+    rounded = (bits + np.uint32(0x7fff) + lsb) >> np.uint32(16)
+    quiet = (bits >> np.uint32(16)) | np.uint32(0x0040)
+    return np.where(nan, quiet, rounded).astype(np.uint16)
+
+
+class DeepSpeedCPULamb(object):
+    """Host LAMB with the DeepSpeedCPUAdam step_flat contract, so the
+    engine's ZeRO-Offload pipeline (chunked copy / OpenMP step / async
+    upload) drives it unchanged."""
+
+    supports_segments = True
+    optimizer_id = 0
+
+    def __init__(self,
+                 model_params=None,
+                 lr=1e-3,
+                 bias_correction=True,
+                 betas=(0.9, 0.999),
+                 eps=1e-8,
+                 weight_decay=0.0,
+                 max_coeff=10.0,
+                 min_coeff=0.01,
+                 amsgrad=False):
+        if amsgrad:
+            raise RuntimeError("CPULamb does not support the AMSGrad variant.")
+        self.opt_id = DeepSpeedCPULamb.optimizer_id
+        DeepSpeedCPULamb.optimizer_id += 1
+        self.bias_correction = bias_correction
+        self.max_coeff = float(max_coeff)
+        self.min_coeff = float(min_coeff)
+        self.param_groups = [{
+            "params": model_params,
+            "lr": lr,
+            "betas": tuple(betas),
+            "eps": eps,
+            "weight_decay": weight_decay,
+        }]
+        self.defaults = {k: v for k, v in self.param_groups[0].items()
+                         if k != "params"}
+        self.state = {}
+        self._step = 0
+        self.lamb_coeffs = []  # last step's trust ratios (reference
+        # fused_lamb_cuda.cpp:42-56 get_lamb_coeffs introspection)
+        self._coeffs_step = None  # step the coeffs accumulator belongs to
+
+        builder = CPULambBuilder()
+        self.ds_opt_lamb = None
+        if builder.is_compatible():
+            try:
+                self.ds_opt_lamb = builder.load()
+            except (RuntimeError, OSError) as e:
+                logger.warning("cpu_lamb build failed (%s); "
+                               "using numpy fallback", e)
+        else:
+            logger.warning("cpu_lamb op incompatible (%s); "
+                           "using numpy fallback", builder.compatible_reason())
+
+    # ------------------------------------------------------------- core step
+    def step_flat(self, params, grads, exp_avg, exp_avg_sq, step=None,
+                  lr=None, bf16_out=None, segments=None):
+        """One LAMB step over contiguous fp32 numpy buffers, in place.
+
+        segments: optional [(offset, size), ...] spans — one trust-ratio
+        domain each (a parameter tensor). Defaults to one span over the
+        whole buffer.
+        """
+        group = self.param_groups[0]
+        if step is None:
+            self._step += 1
+            step = self._step
+        lr = group["lr"] if lr is None else lr
+        beta1, beta2 = group["betas"]
+        eps = group["eps"]
+        wd = group["weight_decay"]
+        assert params.dtype == np.float32 and grads.dtype == np.float32
+        if segments is None:
+            segments = [(0, params.size)]
+
+        # The engine's offload pipeline calls step_flat once per transfer
+        # chunk with the same `step`; coeffs accumulate across those calls
+        # and reset when a new optimizer step begins, so get_lamb_coeffs()
+        # always covers ALL tensors of the latest step (reference
+        # fused_lamb_cuda.cpp:42-56 semantics).
+        if step != self._coeffs_step:
+            self.lamb_coeffs = []
+            self._coeffs_step = step
+        for off, size in segments:
+            sl = slice(off, off + size)
+            ratio = self._step_span(
+                params[sl], grads[sl], exp_avg[sl], exp_avg_sq[sl],
+                step, lr, beta1, beta2, eps, wd,
+                None if bf16_out is None else bf16_out[sl])
+            self.lamb_coeffs.append(ratio)
+
+    def _step_span(self, p, g, m, v, step, lr, beta1, beta2, eps, wd,
+                   bf16_out):
+        if self.ds_opt_lamb is not None:
+            scratch = np.empty_like(p)
+            return float(self.ds_opt_lamb.ds_lamb_step(
+                step, lr, beta1, beta2, eps, wd,
+                int(self.bias_correction), self.max_coeff, self.min_coeff,
+                p.size, as_c_float(p), as_c_float(g), as_c_float(m),
+                as_c_float(v), as_c_float(scratch), as_c_u16(bf16_out)))
+
+        # numpy fallback (same math)
+        np.multiply(m, beta1, out=m)
+        m += (1.0 - beta1) * g
+        np.multiply(v, beta2, out=v)
+        v += (1.0 - beta2) * np.square(g)
+        if self.bias_correction:
+            bc1 = 1.0 - beta1 ** step
+            bc2 = 1.0 - beta2 ** step
+        else:
+            bc1, bc2 = 1.0, 1.0
+        update = (m / bc1) / (np.sqrt(v / bc2) + eps)
+        if wd > 0.0:
+            update = update + wd * p
+        w_norm = float(np.linalg.norm(p))
+        u_norm = float(np.linalg.norm(update))
+        ratio = 1.0
+        if w_norm > 0.0 and u_norm > 0.0:
+            ratio = min(max(w_norm / u_norm, self.min_coeff), self.max_coeff)
+        p -= lr * ratio * update
+        if bf16_out is not None:
+            bf16_out[:] = _bf16_rne(p)
+        return ratio
+
+    def get_lamb_coeffs(self):
+        return list(self.lamb_coeffs)
+
+    # --------------------------------------------------- torch-style surface
+    def step(self, closure=None):
+        """Reference-style step over param_groups of
+        {'params': np_array, 'grads': np_array} dicts."""
+        loss = None
+        if closure is not None:
+            loss = closure()
+        self._step += 1
+        self.lamb_coeffs = []
+        for gi, group in enumerate(self.param_groups):
+            for pi, p in enumerate(group.get("params") or []):
+                if not isinstance(p, dict) or p.get("grads") is None:
+                    continue
+                key = (gi, pi)
+                if key not in self.state:
+                    self.state[key] = {
+                        "exp_avg": np.zeros_like(p["params"]),
+                        "exp_avg_sq": np.zeros_like(p["params"]),
+                    }
+                st = self.state[key]
+                for name in ("params", "grads"):
+                    if not p[name].flags["C_CONTIGUOUS"]:
+                        raise ValueError(
+                            "CPULamb.step requires C-contiguous {} arrays "
+                            "(got a strided view; use np.ascontiguousarray)"
+                            .format(name))
+                ratio = self._step_span(
+                    p["params"].ravel(), p["grads"].ravel(),
+                    st["exp_avg"].ravel(), st["exp_avg_sq"].ravel(),
+                    self._step, group["lr"], *group["betas"],
+                    group["eps"], group["weight_decay"], None)
+                self.lamb_coeffs.append(ratio)
+        return loss

@@ -36,15 +36,52 @@ def _interpret():
     return jax.default_backend() != "tpu"
 
 
+_LUT_OP = None  # lazily-loaded C++ lowering op (None until first use)
+
+
+def _lut_op():
+    """The C++ OpenMP LUT lowering (csrc/sparse_attention/lut.cpp — the
+    reference's sdd_segment tier, csrc/sparse_attention/utils.cpp:119).
+    Returns the bound cdll or False if unavailable."""
+    global _LUT_OP
+    if _LUT_OP is None:
+        from deepspeed_tpu.op_builder import SparseLutBuilder
+        builder = SparseLutBuilder()
+        try:
+            _LUT_OP = builder.load(verbose=False) \
+                if builder.is_compatible() else False
+        except (RuntimeError, OSError):
+            _LUT_OP = False
+    return _LUT_OP
+
+
 def build_luts(layout):
     """Lower a [H, nb, nb] 0/1 layout to forward and transposed LUTs.
 
     Returns (fwd_lut [H, nb, max_deg], bwd_lut [H, nb, max_deg_t]) int32
     numpy arrays padded with -1. fwd_lut[h, i] lists the active key blocks for
     query block i; bwd_lut[h, j] lists the active query blocks for key block j.
+
+    The lowering runs in the C++ OpenMP op when a toolchain is available
+    (one parallel pass per direction); falls back to numpy loops otherwise.
     """
     layout = np.asarray(layout, dtype=bool)
     h, nb, _ = layout.shape
+
+    op = _lut_op()
+    if op:
+        import ctypes
+        lay32 = np.ascontiguousarray(layout, dtype=np.int32)
+        ptr = lay32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+        def lower(transpose):
+            deg = int(op.ds_lut_max_degree(h, nb, nb, ptr, transpose))
+            lut = np.empty((h, nb, deg), dtype=np.int32)
+            op.ds_build_lut(h, nb, nb, ptr, transpose, deg,
+                            lut.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            return lut
+
+        return lower(0), lower(1)
 
     def rows_to_lut(mat):  # mat: [H, rows, cols] bool
         deg = mat.sum(-1).max() if mat.any() else 1

@@ -477,7 +477,9 @@ class DeepSpeedEngine(object):
 
     def _offload_mode(self):
         from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
-        return isinstance(self.optimizer, DeepSpeedCPUAdam)
+        from deepspeed_tpu.ops.lamb.cpu_lamb import DeepSpeedCPULamb
+        return isinstance(self.optimizer, (DeepSpeedCPUAdam,
+                                           DeepSpeedCPULamb))
 
     def _configure_basic_optimizer(self, model_parameters):
         """Optimizer factory table (reference engine.py:577-617)."""
@@ -501,10 +503,25 @@ class DeepSpeedEngine(object):
                              **optimizer_parameters)
         elif name == LAMB_OPTIMIZER:
             if self.zero_cpu_offload():
-                raise ValueError(
-                    "zero_optimization.cpu_offload requires an Adam/AdamW "
-                    "optimizer (got {}); the host tier is DeepSpeedCPUAdam"
-                    .format(name))
+                # Host LAMB tier (the reference's offload matrix is
+                # Adam-only, engine.py:577-617; on the TPU-VM host tier
+                # LAMB composes the same way via csrc/lamb/cpu_lamb.cpp).
+                from deepspeed_tpu.ops.lamb.cpu_lamb import DeepSpeedCPULamb
+                host_keys = ("lr", "bias_correction", "betas", "eps",
+                             "weight_decay", "max_coeff", "min_coeff",
+                             "amsgrad")
+                dropped = [k for k in optimizer_parameters
+                           if k not in host_keys]
+                if dropped:
+                    # Device-only knobs (eps_inside_sqrt, max_grad_norm):
+                    # warn, don't silently change semantics.
+                    logger.warning(
+                        "Lamb params %s are not supported by the host "
+                        "(cpu_offload) tier and are ignored", dropped)
+                return DeepSpeedCPULamb(
+                    model_params=model_parameters,
+                    **{k: v for k, v in optimizer_parameters.items()
+                       if k in host_keys})
             return FusedLamb(params=model_parameters, **optimizer_parameters)
         elif name == ONEBIT_ADAM_OPTIMIZER:
             if self.zero_cpu_offload():
@@ -1242,9 +1259,16 @@ class DeepSpeedEngine(object):
                     host_g[o - lo:o - lo + size] = np.asarray(
                         g_leaves[i], dtype=np.float32).ravel()
                     g_leaves[i] = None  # free this grad leaf's HBM now
+                step_kwargs = {"step": off["step"], "lr": lr}
+                if getattr(opt, "supports_segments", False):
+                    # LAMB trust ratios are per-tensor: each leaf in the
+                    # chunk is its own span.
+                    step_kwargs["segments"] = [
+                        (int(off["offsets"][i]) - lo, off["sizes"][i])
+                        for i in chunk]
                 opt.step_flat(off["master"][lo:hi], host_g,
                               off["m"][lo:hi], off["v"][lo:hi],
-                              step=off["step"], lr=lr)
+                              **step_kwargs)
                 # Upload this chunk's updated params; device_put dispatches
                 # asynchronously, overlapping the next chunk's host Adam.
                 for i in chunk:

@@ -127,6 +127,35 @@ def test_build_luts():
     assert list(bwd[0, 2]) == [1, 2]
 
 
+def test_build_luts_cxx_matches_python():
+    """The C++ OpenMP lowering (csrc/sparse_attention/lut.cpp, the
+    reference's sdd_segment tier) produces the same LUTs as the numpy
+    fallback on random and structured layouts."""
+    from deepspeed_tpu.ops.sparse_attention import kernels as K
+
+    op = K._lut_op()
+    assert op, "sparse_lut op should build in this image"
+
+    rng = np.random.RandomState(0)
+    layouts = [
+        (rng.rand(4, 16, 16) < 0.3).astype(np.int64),
+        np.ones((2, 8, 8), dtype=np.int64),
+        np.zeros((1, 4, 4), dtype=np.int64),  # degenerate: no active blocks
+        FixedSparsityConfig(num_heads=4, block=16,
+                            num_local_blocks=4).make_layout(256).astype(np.int64),
+    ]
+    for layout in layouts:
+        fwd_c, bwd_c = K.build_luts(layout)
+        saved = K._LUT_OP
+        try:
+            K._LUT_OP = False  # force the numpy fallback
+            fwd_py, bwd_py = K.build_luts(layout)
+        finally:
+            K._LUT_OP = saved
+        np.testing.assert_array_equal(fwd_c, fwd_py)
+        np.testing.assert_array_equal(bwd_c, bwd_py)
+
+
 # ---------------------------------------------------------------------------
 # Kernel parity vs dense reference
 # ---------------------------------------------------------------------------
