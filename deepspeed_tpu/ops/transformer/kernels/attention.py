@@ -9,16 +9,27 @@ different: one flash-style Pallas kernel keeps each score block in VMEM and
 never writes the [T, T] matrix to HBM — O(T) memory instead of O(T^2), and
 both GEMMs land on the MXU from the same kernel.
 
-Kernel structure (the part that makes it fast):
-- the key/value block loop is a GRID dimension, not a fori_loop over a
-  whole-[T, d] VMEM residency: Pallas double-buffers the per-block DMAs
-  against compute, so HBM reads overlap the MXU;
+Kernel structure (the part that makes it fast). The kernel is VPU-bound,
+not MXU-bound — at d=64 the score matrix has 16x more elements than the
+q/o blocks, so every elementwise pass over [bq, bk] fp32 scores costs more
+than the matmuls. The design therefore minimises score-matrix passes:
+- q is PRE-SCALED by 1/sqrt(d) outside the kernel ([T, d] pass instead of
+  a [T, T] pass in every kernel);
+- the causal mask is a CONSTANT additive tril block passed as an input and
+  applied only to diagonal (straddling) blocks — fully-active blocks skip
+  masking entirely, fully-masked blocks are skipped by @pl.when and their
+  index map clamps to the last useful block (no new DMA for a repeated
+  index). Per-block iota/compare/select ladders only remain for the
+  uncommon block_q != block_k causal shapes;
+- when the kv extent is a single block, the online-softmax machinery
+  (running max/sum scratch, accumulator rescale) collapses to one direct
+  softmax with no scratch at all;
 - matmul inputs stay in the model dtype (bf16) with fp32 MXU accumulation
-  (preferred_element_type); softmax statistics and the output accumulator
-  live in fp32 VMEM scratch across grid steps;
-- causal masking skips fully-masked key blocks: their index map clamps to
-  the last useful block (no new DMA is issued for a repeated index) and
-  @pl.when skips the compute.
+  (preferred_element_type); softmax statistics and accumulators live in
+  fp32 VMEM scratch across grid steps;
+- in the backward, the 1/sqrt(d) factor on dq is applied to the [T, d]
+  OUTPUT (dk/dv need no factor at all with pre-scaled q), never to the
+  [T, T] ds matrix.
 
 Forward: online-softmax accumulation over key/value blocks.
 Backward: standard two-pass flash backward (one kernel produces dq looping
@@ -31,10 +42,14 @@ tests/unit/test_cuda_forward.py / test_cuda_backward.py grids).
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.custom_partitioning import custom_partitioning
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30
 # Lane width for the fp32 softmax-statistic scratch rows: Mosaic pads
@@ -79,20 +94,77 @@ def _first_q_block(jk, block_q, block_k):
     return (jk * block_k) // block_q
 
 
+def _tril_block(block_q, block_k):
+    """Constant additive causal mask for a diagonal block (bq == bk).
+    Built from iota primitives (not a materialized array) so functions
+    passing it stay const-free — custom_partitioning requires closed
+    jaxprs; XLA folds it to a constant anyway."""
+    r = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(r >= c, jnp.float32(0.0), jnp.float32(NEG_INF))
+
+
+def _apply_causal(s, iq, j, block_q, block_k, tril_ref):
+    """Apply the causal mask to score block (iq, j). With bq == bk only the
+    diagonal block straddles the boundary, so the constant tril input is
+    added under @pl.when; otherwise fall back to the iota ladder."""
+    if tril_ref is not None:
+        return jax.lax.cond(iq == j, lambda: s + tril_ref[...], lambda: s)
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 0)
+    k_pos = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(*refs, scale, causal, block_q, block_k, has_mask):
+def _fwd_kernel(*refs, causal, block_q, block_k, has_mask, has_tril,
+                single_kv):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    idx = 3
+    mask_ref = tril_ref = None
     if has_mask:
-        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc, m_s, l_s = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s = refs
-        mask_ref = None
+        mask_ref = refs[idx]
+        idx += 1
+    if has_tril:
+        tril_ref = refs[idx]
+        idx += 1
+    o_ref, lse_ref = refs[idx:idx + 2]
+    scratch = refs[idx + 2:]
 
     iq = pl.program_id(2)
     j = pl.program_id(3)
     n_kv = pl.num_programs(3)
+
+    def scores():
+        s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if mask_ref is not None:
+            s = s + mask_ref[0][None, :]
+        if causal:
+            s = _apply_causal(s, iq, j, block_q, block_k, tril_ref)
+        return s
+
+    if single_kv:
+        # One kv block: direct softmax, no scratch, no rescale passes.
+        s = scores()
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        v_blk = v_ref[0, 0]
+        pv = jax.lax.dot_general(p.astype(v_blk.dtype), v_blk,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        o_ref[0, 0] = (pv / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m + jnp.log(l)
+        return
+
+    acc, m_s, l_s = scratch
 
     @pl.when(j == 0)
     def _init():
@@ -107,20 +179,7 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, has_mask):
 
     @pl.when(active)
     def _compute():
-        q = q_ref[0, 0]                                    # [bq, d] model dtype
-        k_blk = k_ref[0, 0]                                # [bk, d]
-        v_blk = v_ref[0, 0]
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if mask_ref is not None:
-            s = s + mask_ref[0][None, :]
-        if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-
+        s = scores()
         m_prev = m_s[:, 0:1]                               # [bq, 1]
         l_prev = l_s[:, 0:1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -131,6 +190,7 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, has_mask):
         m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
         l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
         # Second MXU matmul in the model dtype with fp32 accumulation.
+        v_blk = v_ref[0, 0]
         pv = jax.lax.dot_general(p.astype(v_blk.dtype), v_blk,
                                  (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -143,7 +203,7 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, has_mask):
         lse_ref[0, 0] = m_s[:, 0:1] + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k):
+def _flash_fwd_pallas(q, k, v, mask, scale, causal, block_q, block_k):
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, t_q, d = q.shape
@@ -152,6 +212,10 @@ def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k):
     block_k = min(block_k, t_kv)
     n_kv = pl.cdiv(t_kv, block_k)
     grid = (b, h, pl.cdiv(t_q, block_q), n_kv)
+    # Pre-scale q: one [T, d] pass replaces a [T, T] pass per kernel.
+    q = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    use_tril = causal and block_q == block_k
+    single_kv = n_kv == 1
 
     if causal:
         def kv_index(b_, h_, i, j):
@@ -172,11 +236,16 @@ def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k):
         in_specs.append(
             pl.BlockSpec((1, block_k), lambda b_, h_, i, j: (b_, kv_index(b_, h_, i, j)[2])))
         args.append(mask.astype(jnp.float32))
+    if use_tril:
+        in_specs.append(
+            pl.BlockSpec((block_q, block_k), lambda b_, h_, i, j: (0, 0)))
+        args.append(_tril_block(block_q, block_k))
 
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+        functools.partial(_fwd_kernel, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          has_mask=mask is not None),
+                          has_mask=mask is not None, has_tril=use_tril,
+                          single_kv=single_kv),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
@@ -187,7 +256,7 @@ def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k):
             jax.ShapeDtypeStruct((b, h, t_q, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, t_q, 1), jnp.float32),
         ],
-        scratch_shapes=[
+        scratch_shapes=[] if single_kv else [
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
             pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
@@ -200,22 +269,69 @@ def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k):
 # ---------------------------------------------------------------------------
 # Backward
 # ---------------------------------------------------------------------------
-# delta_i = rowsum(dO_i * O_i); then
-#   dS = P * (dP - delta),  dq = dS K,  dk = dS^T q,  dv = P^T dO
-# P is recomputed blockwise from q, k and the saved lse (never stored).
+# delta_i = rowsum(dO_i * O_i); then with q_s = q/sqrt(d):
+#   s = q_s K^T,  P = exp(s - lse),  dP = dO V^T,  dS = P * (dP - delta)
+#   dq = (dS K) / sqrt(d),  dk = dS^T q_s,  dv = P^T dO
+# P is recomputed blockwise from q_s, k and the saved lse (never stored).
 
-def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, has_mask):
+def _bwd_unpack(refs, has_mask, has_tril, n_out):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    idx = 3
+    mask_ref = tril_ref = None
     if has_mask:
-        (q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-         dq_ref, dq_acc) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-         dq_acc) = refs
-        mask_ref = None
+        mask_ref = refs[idx]
+        idx += 1
+    if has_tril:
+        tril_ref = refs[idx]
+        idx += 1
+    do_ref, lse_ref, delta_ref = refs[idx:idx + 3]
+    idx += 3
+    outs = refs[idx:idx + n_out]
+    scratch = refs[idx + n_out:]
+    return (q_ref, k_ref, v_ref, mask_ref, tril_ref, do_ref, lse_ref,
+            delta_ref, outs, scratch)
+
+
+def _bwd_scores(q_ref, k_ref, mask_ref, tril_ref, iq, j, causal,
+                block_q, block_k):
+    s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if mask_ref is not None:
+        s = s + mask_ref[0][None, :]
+    if causal:
+        s = _apply_causal(s, iq, j, block_q, block_k, tril_ref)
+    return s
+
+
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, has_mask,
+                   has_tril, single_kv):
+    (q_ref, k_ref, v_ref, mask_ref, tril_ref, do_ref, lse_ref, delta_ref,
+     (dq_ref,), scratch) = _bwd_unpack(refs, has_mask, has_tril, 1)
 
     iq = pl.program_id(2)
     j = pl.program_id(3)
     n_kv = pl.num_programs(3)
+
+    def ds_block():
+        s = _bwd_scores(q_ref, k_ref, mask_ref, tril_ref, iq, j, causal,
+                        block_q, block_k)
+        p = jnp.exp(s - lse_ref[0, 0])                     # [bq, bk] fp32
+        v_blk = v_ref[0, 0]
+        do = do_ref[0, 0]
+        dp = jax.lax.dot_general(do.astype(v_blk.dtype), v_blk,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0, 0])).astype(k_ref.dtype)
+        return jax.lax.dot_general(ds, k_ref[0, 0], (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+    if single_kv:
+        dq_ref[0, 0] = (ds_block() * scale).astype(dq_ref.dtype)
+        return
+
+    (dq_acc,) = scratch
 
     @pl.when(j == 0)
     def _init():
@@ -228,48 +344,64 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, has_mask):
 
     @pl.when(active)
     def _compute():
-        q = q_ref[0, 0]                                    # [bq, d]
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]                                # [bq, 1]
-        delta = delta_ref[0, 0]
-        k_blk = k_ref[0, 0]
-        v_blk = v_ref[0, 0]
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if mask_ref is not None:
-            s = s + mask_ref[0][None, :]
-        if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                               # [bq, bk] fp32
-        dp = jax.lax.dot_general(do.astype(v_blk.dtype), v_blk,
-                                 (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta) * scale).astype(k_blk.dtype)
-        dq_acc[...] += jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dq_acc[...] += ds_block()
 
     @pl.when(j == n_kv - 1)
     def _finalize():
-        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+        dq_ref[0, 0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, has_mask):
-    if has_mask:
-        (q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_acc, dv_acc) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-         dv_ref, dk_acc, dv_acc) = refs
-        mask_ref = None
+def _bwd_dkv_kernel(*refs, causal, block_q, block_k, has_mask, has_tril,
+                    single_q):
+    (q_ref, k_ref, v_ref, mask_ref, tril_ref, do_ref, lse_ref, delta_ref,
+     (dk_ref, dv_ref), scratch) = _bwd_unpack(refs, has_mask, has_tril, 2)
 
     jk = pl.program_id(2)
     i = pl.program_id(3)
     n_q = pl.num_programs(3)
+
+    def grads_block():
+        s = _bwd_scores(q_ref, k_ref, mask_ref, tril_ref, i, jk, causal,
+                        block_q, block_k)
+        p = jnp.exp(s - lse_ref[0, 0])                     # [bq, bk] fp32
+        do = do_ref[0, 0]
+        p_cast = p.astype(do.dtype)
+        dv = jax.lax.dot_general(p_cast, do, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        v_blk = v_ref[0, 0]
+        dp = jax.lax.dot_general(do.astype(v_blk.dtype), v_blk,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0, 0])).astype(q_ref.dtype)
+        dk = jax.lax.dot_general(ds, q_ref[0, 0], (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if single_q:
+        if causal:
+            # A kv block entirely past the query extent (t_kv > t_q) gets
+            # no probability mass — the diagonal tril only covers i == jk,
+            # so these blocks must be zeroed explicitly (the multi-block
+            # path's `active` guard; verified by the t_q<t_kv grad test).
+            active = i >= _first_q_block(jk, block_q, block_k)
+
+            @pl.when(active)
+            def _write():
+                dk, dv = grads_block()
+                dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+                dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+            @pl.when(jnp.logical_not(active))
+            def _zero():
+                dk_ref[0, 0] = jnp.zeros_like(dk_ref[0, 0])
+                dv_ref[0, 0] = jnp.zeros_like(dv_ref[0, 0])
+        else:
+            dk, dv = grads_block()
+            dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+            dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+        return
+
+    dk_acc, dv_acc = scratch
 
     @pl.when(i == 0)
     def _init():
@@ -283,34 +415,9 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, has_mask):
 
     @pl.when(active)
     def _compute():
-        k_blk = k_ref[0, 0]                                # [bk, d]
-        v_blk = v_ref[0, 0]
-        q = q_ref[0, 0]                                    # [bq, d]
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0]                                # [bq, 1]
-        delta = delta_ref[0, 0]
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if mask_ref is not None:
-            s = s + mask_ref[0][None, :]
-        if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = jk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                               # [bq, bk] fp32
-        p_cast = p.astype(do.dtype)
-        dv_acc[...] += jax.lax.dot_general(
-            p_cast, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do.astype(v_blk.dtype), v_blk,
-                                 (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
-        dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dk, dv = grads_block()
+        dk_acc[...] += dk
+        dv_acc[...] += dv
 
     @pl.when(i == n_q - 1)
     def _finalize():
@@ -318,10 +425,13 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, has_mask):
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, scale, causal, block_q, block_k):
+def _flash_bwd_pallas(q, k, v, mask, delta, lse, g, scale, causal, block_q,
+                      block_k):
+    """delta: [B, H, T, 1] fp32 = rowsum(dO * O) (minus any lse cotangent —
+    see _flash_attention_lse); computed by the caller so this function stays
+    const-free and delta-shifts need no new partitioning variant."""
     from jax.experimental.pallas import tpu as pltpu
 
-    q, k, v, mask, o, lse = res
     b, h, t_q, d = q.shape
     t_kv = k.shape[2]
     block_q = min(block_q, t_q)
@@ -329,8 +439,11 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k):
     n_q = pl.cdiv(t_q, block_q)
     n_kv = pl.cdiv(t_kv, block_k)
     do = g
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)
+    # Same pre-scaled q as the forward (so the recomputed P matches the
+    # saved lse); dk needs no correction, dq is rescaled on its output.
+    q = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    use_tril = causal and block_q == block_k
+    tril = _tril_block(block_q, block_k) if use_tril else None
 
     # dq: grid over (q block, kv block), kv innermost and pipelined.
     if causal:
@@ -342,6 +455,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k):
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
     kv_spec = pl.BlockSpec((1, 1, block_k, d), kv_index)
     row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+    tril_spec = pl.BlockSpec((block_q, block_k), lambda b_, h_, i, j: (0, 0))
 
     in_specs = [q_spec, kv_spec, kv_spec]
     args = [q, k, v]
@@ -349,24 +463,34 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k):
         in_specs.append(
             pl.BlockSpec((1, block_k), lambda b_, h_, i, j: (b_, kv_index(b_, h_, i, j)[2])))
         args.append(mask.astype(jnp.float32))
+    if use_tril:
+        in_specs.append(tril_spec)
+        args.append(tril)
     in_specs += [q_spec, row_spec, row_spec]
     args += [do, lse, delta]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          has_mask=mask is not None),
+                          has_mask=mask is not None, has_tril=use_tril,
+                          single_kv=n_kv == 1),
         grid=(b, h, n_q, n_kv),
         in_specs=in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        scratch_shapes=[] if n_kv == 1 else
+        [pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(*args)
 
     # dk/dv: grid over (kv block, q block), q innermost and pipelined.
     if causal:
         def q_index(b_, h_, jk, i):
-            return (b_, h_, jnp.maximum(i, _first_q_block(jk, block_q, block_k)), 0)
+            # Clamp into the valid block range: fully-inactive kv blocks
+            # (first active q block past the end) skip compute, so reading
+            # the last block instead issues no stray DMA.
+            first = jnp.minimum(_first_q_block(jk, block_q, block_k),
+                                n_q - 1)
+            return (b_, h_, jnp.maximum(i, first), 0)
     else:
         def q_index(b_, h_, jk, i):
             return (b_, h_, i, 0)
@@ -380,22 +504,154 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k):
     if mask is not None:
         in_specs.append(pl.BlockSpec((1, block_k), lambda b_, h_, jk, i: (b_, jk)))
         args.append(mask.astype(jnp.float32))
+    if use_tril:
+        in_specs.append(
+            pl.BlockSpec((block_q, block_k), lambda b_, h_, jk, i: (0, 0)))
+        args.append(tril)
     in_specs += [q_spec2, row_spec2, row_spec2]
     args += [do, lse, delta]
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+        functools.partial(_bwd_dkv_kernel, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          has_mask=mask is not None),
+                          has_mask=mask is not None, has_tril=use_tril,
+                          single_q=n_q == 1),
         grid=(b, h, n_kv, n_q),
         in_specs=in_specs,
         out_specs=[kv_spec2, kv_spec2],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
-        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
-                        pltpu.VMEM((block_k, d), jnp.float32)],
+        scratch_shapes=[] if n_q == 1 else
+        [pltpu.VMEM((block_k, d), jnp.float32),
+         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=_interpret(),
     )(*args)
 
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# GSPMD integration — batch/head-parallel partitioning of the kernels.
+#
+# XLA's SPMD partitioner cannot see inside a pallas_call: without a rule it
+# replicates the operands ("involuntary full rematerialization"), turning
+# data-parallel attention into a full all-gather per step. The kernels are
+# embarrassingly parallel over batch and heads, so custom_partitioning
+# declares exactly that: b/h follow the operand sharding, sequence and
+# head-dim are replicated (for both the GSPMD callback API and the Shardy
+# einsum rule). Each shard then runs the plain pallas kernel on its local
+# [b/dp, h/mp, T, D] block. This is the TPU analogue of the reference's
+# data-parallel engine wrapping its CUDA kernels (engine.py:508-528 —
+# kernels see local tensors, the framework owns the distribution).
+# ---------------------------------------------------------------------------
+
+
+def _use_custom_partitioning():
+    return os.environ.get("DS_TPU_NO_CUSTOM_PARTITION", "0") != "1"
+
+
+def _bh_spec(sharding):
+    """(batch, head) partition entries of an operand sharding, or (None,
+    None) when unknown/unsharded."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return (None, None)
+    spec = tuple(spec) + (None,) * (4 - len(spec))
+    return spec[0], spec[1]
+
+
+def _cp_wrap(fn, n_in, n_out, rule, mask_pos=None):
+    """Wrap fn (shard-local pallas launcher) in custom_partitioning with
+    b/h-parallel shardings. Inputs/outputs are [B, H, ...] except an
+    optional [B, T_kv] mask at mask_pos; lse outputs are [B, H, T, 1]."""
+    cp = custom_partitioning(fn)
+
+    def shardings(mesh, q_sharding):
+        b, h = _bh_spec(q_sharding)
+        full = NamedSharding(mesh, P(b, h, None, None))
+        mask_sh = NamedSharding(mesh, P(b, None))
+        args = tuple(full if i != mask_pos else mask_sh
+                     for i in range(n_in))
+        outs = (full,) * n_out
+        return args, outs
+
+    def infer(mesh, arg_shapes, shape):
+        _, outs = shardings(mesh, arg_shapes[0].sharding)
+        return outs if n_out > 1 else outs[0]
+
+    def partition(mesh, arg_shapes, result_shape):
+        args, outs = shardings(mesh, arg_shapes[0].sharding)
+        return mesh, fn, (outs if n_out > 1 else outs[0]), args
+
+    cp.def_partition(
+        partition=partition,
+        infer_sharding_from_operands=infer,
+        sharding_rule=rule,
+        # Ordered by first appearance in the rule (Shardy requires sorted
+        # factor indices): t then d (from q), s (from k), u (from lse).
+        need_replication_factors=("t", "d", "s", "u"))
+    return cp
+
+
+@functools.lru_cache(maxsize=None)
+def _fwd_partitioned(has_mask, scale, causal, block_q, block_k):
+    if has_mask:
+        def f(q, k, v, mask):
+            return _flash_fwd_pallas(q, k, v, mask, scale, causal,
+                                     block_q, block_k)
+        rule = "b h t d, b h s d, b h s d, b s -> b h t d, b h t u"
+        return _cp_wrap(f, 4, 2, rule, mask_pos=3)
+
+    def f(q, k, v):
+        return _flash_fwd_pallas(q, k, v, None, scale, causal,
+                                 block_q, block_k)
+    rule = "b h t d, b h s d, b h s d -> b h t d, b h t u"
+    return _cp_wrap(f, 3, 2, rule)
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_partitioned(has_mask, scale, causal, block_q, block_k):
+    if has_mask:
+        def f(q, k, v, mask, delta, lse, do):
+            return _flash_bwd_pallas(q, k, v, mask, delta, lse, do, scale,
+                                     causal, block_q, block_k)
+        rule = ("b h t d, b h s d, b h s d, b s, b h t u, b h t u, b h t d "
+                "-> b h t d, b h s d, b h s d")
+        return _cp_wrap(f, 7, 3, rule, mask_pos=3)
+
+    def f(q, k, v, delta, lse, do):
+        return _flash_bwd_pallas(q, k, v, None, delta, lse, do, scale,
+                                 causal, block_q, block_k)
+    rule = ("b h t d, b h s d, b h s d, b h t u, b h t u, b h t d "
+            "-> b h t d, b h s d, b h s d")
+    return _cp_wrap(f, 6, 3, rule)
+
+
+def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k):
+    if _use_custom_partitioning():
+        f = _fwd_partitioned(mask is not None, scale, causal,
+                             block_q, block_k)
+        args = (q, k, v) if mask is None else (q, k, v, mask)
+        return f(*args)
+    return _flash_fwd_pallas(q, k, v, mask, scale, causal, block_q, block_k)
+
+
+def _flash_bwd(res, g, scale, causal, block_q, block_k, dlse=None):
+    q, k, v, mask, o, lse = res
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    if dlse is not None:
+        # An lse cotangent folds into the same kernels: dlse_i/ds_ij = p_ij,
+        # so ds = p * (dp - (delta - dlse)) — a pure delta shift.
+        delta = delta - dlse
+    if _use_custom_partitioning():
+        f = _bwd_partitioned(mask is not None, scale, causal,
+                             block_q, block_k)
+        args = (q, k, v, delta, lse, g) if mask is None else \
+            (q, k, v, mask, delta, lse, g)
+        dq, dk, dv = f(*args)
+    else:
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, mask, delta, lse, g, scale,
+                                       causal, block_q, block_k)
     dmask = None if mask is None else jnp.zeros_like(mask)
     return dq, dk, dv, dmask
 
@@ -420,6 +676,55 @@ def _flash_attention_bwd(scale, causal, block_q, block_k, res, g):
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention_lse(q, k, v, mask, scale, causal, block_q, block_k):
+    """(o, lse) variant — lse is differentiable too (ring attention merges
+    partial results through it)."""
+    return _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k)
+
+
+def _flash_attention_lse_fwd(q, k, v, mask, scale, causal, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k)
+    return (o, lse), (q, k, v, mask, o, lse)
+
+
+def _flash_attention_lse_bwd(scale, causal, block_q, block_k, res, g):
+    do, dlse = g
+    return _flash_bwd(res, do, scale, causal, block_q, block_k, dlse=dlse)
+
+
+_flash_attention_lse.defvjp(_flash_attention_lse_fwd,
+                            _flash_attention_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, mask=None, causal=False, scale=None,
+                             block_q=None, block_k=None):
+    """flash_attention returning (o, lse[B, H, T, 1] fp32); both outputs
+    are differentiable. Ragged shapes fall back to the jnp path."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    t_q, t_kv = q.shape[2], k.shape[2]
+    if block_q is None and block_k is None and not _interpret():
+        block_q, block_k = _autotuned_blocks(q, k, v, causal, 1024, 1024)
+    block_q = min(int(block_q or 1024), t_q)
+    block_k = min(int(block_k or 1024), t_kv)
+    if t_q % block_q or t_kv % block_k:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        if mask is not None:
+            s = s + mask[:, None, None, :].astype(jnp.float32)
+        if causal:
+            cm = jnp.tril(jnp.ones((t_q, t_kv), dtype=bool))
+            s = jnp.where(cm[None, None], s, NEG_INF)
+        lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jnp.exp(s - lse),
+                       v.astype(jnp.float32)).astype(q.dtype)
+        return o, lse
+    return _flash_attention_lse(q, k, v, mask, float(scale), bool(causal),
+                                block_q, block_k)
 
 
 def _autotuned_blocks(q, k, v, causal, default_q, default_k):
@@ -493,8 +798,7 @@ def flash_attention(q, k, v, mask=None, causal=False, scale=None,
       scale: score scale; default 1/sqrt(D).
       block_q, block_k: VMEM tile sizes. Default (None) consults the
         per-shape autotuner table (ops/autotuner.py); its fallback 1024x1024
-        was tuned on v5e (GPT-2 355M shapes, d=64): 2.1x over dense XLA
-        attention at T=1024 fwd+bwd, 3.0x at T=2048.
+        was tuned on v5e (GPT-2 355M shapes, d=64).
     Returns: [B, H, T, D] in q.dtype.
     """
     d = q.shape[-1]

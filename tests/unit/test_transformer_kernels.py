@@ -61,6 +61,30 @@ def test_flash_attention_backward(causal):
         np.testing.assert_allclose(a, b_, rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.parametrize("t_q,t_kv,blk", [(16, 32, 16), (32, 16, 16),
+                                          (16, 64, 16)])
+def test_flash_attention_backward_cross_lengths(t_q, t_kv, blk):
+    """Causal grads with t_q != t_kv — regression for the single-q-block
+    dkv path, where kv blocks entirely past the query extent must receive
+    zero gradient (they got unmasked garbage before the fix)."""
+    rng = np.random.RandomState(5)
+    b, h, d = 2, 2, 16
+    q = rand(rng, b, h, t_q, d)
+    k, v = rand(rng, b, h, t_kv, d), rand(rng, b, h, t_kv, d)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=blk, block_k=blk) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g, gr):
+        np.testing.assert_allclose(a, b_, rtol=1e-3, atol=1e-4)
+
+
 def test_flash_attention_ragged_fallback():
     # Non-divisible seq lengths take the jnp path; result must still match.
     rng = np.random.RandomState(5)
