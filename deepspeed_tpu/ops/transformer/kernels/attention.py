@@ -67,9 +67,12 @@ def _interpret():
 # fallback for shapes the kernel does not support.
 # ---------------------------------------------------------------------------
 
-def mha_reference(q, k, v, mask=None, causal=False, scale=None):
+def mha_reference(q, k, v, mask=None, causal=False, scale=None,
+                  return_lse=False):
     """q,k,v: [B, H, T, D]; mask: additive [B, T_kv] (broadcast over heads
-    and query rows, the BERT padding-mask shape)."""
+    and query rows, the BERT padding-mask shape). With return_lse, also
+    returns the per-row logsumexp [B, H, T, 1] fp32 (the ragged fallback
+    of flash_attention_with_lse shares this single dense implementation)."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -80,8 +83,18 @@ def mha_reference(q, k, v, mask=None, causal=False, scale=None):
         t_q, t_k = q.shape[2], k.shape[2]
         cm = jnp.tril(jnp.ones((t_q, t_k), dtype=bool))
         s = jnp.where(cm[None, None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+    # Normalize by DIVISION, not exp(s - lse): at mask magnitudes (-1e9)
+    # fp32 loses log-sum bits in lse (-1e9 + log2 rounds back to -1e9), so
+    # exp(s - lse) silently denormalizes fully-masked rows. Division keeps
+    # the row sum exact — the same stability structure as the flash kernel.
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", e / l,
+                   v.astype(jnp.float32)).astype(q.dtype)
+    if return_lse:
+        return o, m + jnp.log(l)
+    return o
 
 
 def _last_kv_block(iq, block_q, block_k):
@@ -505,8 +518,7 @@ def _flash_bwd_pallas(q, k, v, mask, delta, lse, g, scale, causal, block_q,
         in_specs.append(pl.BlockSpec((1, block_k), lambda b_, h_, jk, i: (b_, jk)))
         args.append(mask.astype(jnp.float32))
     if use_tril:
-        in_specs.append(
-            pl.BlockSpec((block_q, block_k), lambda b_, h_, jk, i: (0, 0)))
+        in_specs.append(tril_spec)
         args.append(tril)
     in_specs += [q_spec2, row_spec2, row_spec2]
     args += [do, lse, delta]
@@ -712,17 +724,8 @@ def flash_attention_with_lse(q, k, v, mask=None, causal=False, scale=None,
     block_q = min(int(block_q or 1024), t_q)
     block_k = min(int(block_k or 1024), t_kv)
     if t_q % block_q or t_kv % block_k:
-        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                       k.astype(jnp.float32)) * scale
-        if mask is not None:
-            s = s + mask[:, None, None, :].astype(jnp.float32)
-        if causal:
-            cm = jnp.tril(jnp.ones((t_q, t_kv), dtype=bool))
-            s = jnp.where(cm[None, None], s, NEG_INF)
-        lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
-        o = jnp.einsum("bhqk,bhkd->bhqd", jnp.exp(s - lse),
-                       v.astype(jnp.float32)).astype(q.dtype)
-        return o, lse
+        return mha_reference(q, k, v, mask=mask, causal=causal,
+                             scale=scale, return_lse=True)
     return _flash_attention_lse(q, k, v, mask, float(scale), bool(causal),
                                 block_q, block_k)
 

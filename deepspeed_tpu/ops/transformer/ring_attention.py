@@ -114,10 +114,17 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
         else:
             o_p, lse_p = full_block()
         o, lse = _merge(o, lse, o_p, lse_p)
-        # Rotate k/v for the next step (skipped on the final iteration's
-        # result but kept in the scan body for a uniform trace).
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+
+        # Rotate k/v for the next step. The final step's rotation would be
+        # discarded — skip it (the predicate is the scan counter, identical
+        # on every device, so the collective stays globally consistent).
+        def rotate(kv):
+            k_b, v_b = kv
+            return (jax.lax.ppermute(k_b, axis_name, perm),
+                    jax.lax.ppermute(v_b, axis_name, perm))
+
+        k_blk, v_blk = jax.lax.cond(s < n - 1, rotate, lambda kv: kv,
+                                    (k_blk, v_blk))
         return (o, lse, k_blk, v_blk), None
 
     (o, lse, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v),

@@ -1,0 +1,87 @@
+"""Model-level convergence tests (the reference's tests/model tier:
+Megatron_GPT2/run_func_test.py compares loss curves against recorded
+baselines across mp x zero-stage x offload matrices; BingBertSquad gates
+on F1). Scaled to CI size: tiny GPT-2 / BERT train on a synthetic
+memorization task on the 8-device CPU mesh and must reach a loss
+threshold — a real convergence gate, not just "loss went down" — and the
+parallel configs must track the serial loss curve within tolerance
+(the reference's curve-comparison idea, test_common.py:12-70).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as deepspeed
+
+
+def _train_gpt2(config_extra, steps=60, seed=0):
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    model = GPT2LMHeadModel(cfg)
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+    }
+    config.update(config_extra)
+    engine, _, _, _ = deepspeed.initialize(model=model, config_params=config)
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, size=(8, 32))
+    losses = []
+    for _ in range(steps):
+        loss = engine.train_batch(batch=(ids, ids))
+        losses.append(float(loss))
+    return losses
+
+
+def test_gpt2_memorizes_batch():
+    """Serial baseline: a tiny GPT-2 must memorize one batch (loss < 1.0
+    from ~6.9 in 60 steps) — convergence, not smoke."""
+    losses = _train_gpt2({})
+    assert losses[0] > 5.0
+    assert losses[-1] < 1.0, "did not converge: {}".format(losses[-5:])
+
+
+@pytest.mark.parametrize("zero_stage", [1, 2, 3])
+def test_gpt2_zero_tracks_serial_curve(zero_stage):
+    """ZeRO configs must follow the serial loss curve (reference
+    run_func_test.py checks curves within tolerance, test_common.py)."""
+    base = _train_gpt2({}, steps=25)
+    zero = _train_gpt2(
+        {"zero_optimization": {"stage": zero_stage},
+         "bf16": {"enabled": True}}, steps=25)
+    # bf16 + sharded arithmetic: same trajectory within a few percent.
+    np.testing.assert_allclose(zero, base, rtol=0.08, atol=0.05)
+    assert zero[-1] < base[0] * 0.7
+
+
+def test_bert_mlm_converges():
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
+
+    cfg = BertConfig.tiny(hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0)
+    engine, _, _, _ = deepspeed.initialize(
+        model=BertForPreTraining(cfg),
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+        })
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, cfg.vocab_size, size=(8, 32))
+    # 15% of positions are supervised ([MASK]-style corruption: replaced
+    # with a random token, original id as label; the rest -1-ignored).
+    labels = np.where(rng.rand(8, 32) < 0.15, ids, -1)
+    inputs = np.where(labels >= 0,
+                      rng.randint(0, cfg.vocab_size, size=(8, 32)), ids)
+    nsp = rng.randint(0, 2, size=(8,))
+    losses = []
+    for _ in range(60):
+        loss = engine(inputs, None, None, jnp.asarray(labels),
+                      jnp.asarray(nsp))
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.35, losses[-5:]

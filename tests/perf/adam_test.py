@@ -1,0 +1,64 @@
+"""CPU-Adam / CPU-LAMB step-latency microbenchmark (mirrors reference
+tests/perf/adam_test.py: time optimizer.step over a ~1 GB parameter group).
+
+Run directly (not collected by pytest — no test_ functions):
+    python tests/perf/adam_test.py [n_elements]
+
+Prints per-step latency and effective bandwidth for the C++ OpenMP ops and
+the numpy fallbacks. Default size is 64M elements (~1 GB across the four
+fp32 buffers); pass the reference's 1GiB-of-params size explicitly with
+`python tests/perf/adam_test.py 268435456` when the host has >4 GB free.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam  # noqa: E402
+from deepspeed_tpu.ops.lamb.cpu_lamb import DeepSpeedCPULamb  # noqa: E402
+
+
+def bench(opt, name, n, steps=20, **kw):
+    p = np.ones(n, np.float32)
+    g = np.full(n, 0.5, np.float32)
+    m = np.zeros(n, np.float32)
+    v = np.zeros(n, np.float32)
+    opt.step_flat(p, g, m, v, step=1, **kw)  # warm (faults pages in)
+    t0 = time.time()
+    for s in range(2, steps + 2):
+        opt.step_flat(p, g, m, v, step=s, **kw)
+    dt = (time.time() - t0) / steps
+    gb = 4 * n * 4 / 1e9  # 4 fp32 streams read+written dominate
+    print("%-22s n=%d  %7.2f ms/step  %6.1f GB/s traffic" %
+          (name, n, dt * 1e3, gb / dt))
+    return dt
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 64 * 1024 * 1024
+
+    adam = DeepSpeedCPUAdam(lr=1e-3, weight_decay=0.01)
+    assert adam.ds_opt_adam is not None, "C++ op did not build"
+    t_cxx = bench(adam, "cpu_adam (C++)", n)
+    bf16 = np.zeros(n, np.uint16)
+    bench(adam, "cpu_adam (C++ +bf16)", n, bf16_out=bf16)
+
+    lamb = DeepSpeedCPULamb(lr=1e-3, weight_decay=0.01)
+    assert lamb.ds_opt_lamb is not None, "C++ op did not build"
+    bench(lamb, "cpu_lamb (C++)", n)
+
+    fallback = DeepSpeedCPUAdam(lr=1e-3, weight_decay=0.01)
+    fallback.ds_opt_adam = None
+    t_np = bench(fallback, "cpu_adam (numpy)", n, steps=5)
+    print("C++ speedup over numpy: %.1fx  (reference claims 5-7x over "
+          "torch.optim.Adam, ops/adam/cpu_adam.py docstring)" %
+          (t_np / t_cxx))
+
+
+if __name__ == "__main__":
+    main()
