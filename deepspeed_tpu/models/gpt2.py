@@ -37,6 +37,14 @@ class GPT2Config:
     # and beats XLA's dense attention on v5e (355M shapes: 4.5 vs 9.5
     # ms/layer fwd+bwd at T=1024, 9.7 vs 29.3 at T=2048) — on by default.
     use_flash_attention: bool = True
+    # Sequence (context) parallelism: name of the mesh axis the sequence
+    # dim is sharded over. When set AND the model runs inside shard_map
+    # with that axis bound (the engine's sequence_parallel config does
+    # this), positions are offset per shard, attention runs as a ring
+    # (ops/transformer/ring_attention.py), and the loss is globally
+    # averaged via psum. Outside shard_map the model behaves normally, so
+    # init/eval on the full sequence work unchanged.
+    sequence_parallel_axis: Any = None
 
     @classmethod
     def gpt2_small(cls, **kw):
@@ -69,6 +77,19 @@ class GPT2Config:
         return wte + wpe + self.n_layer * per_block + 2 * self.n_embd
 
 
+def _sp_axis(cfg):
+    """The sequence-parallel axis name IF the model is being traced inside
+    a shard_map that binds it; None otherwise (init / serial eval)."""
+    axis = getattr(cfg, "sequence_parallel_axis", None)
+    if axis is None:
+        return None
+    try:
+        jax.lax.axis_index(axis)
+    except NameError:
+        return None
+    return axis
+
+
 class CausalSelfAttention(nn.Module):
     config: GPT2Config
 
@@ -85,7 +106,15 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
         v = v.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
 
-        if cfg.use_flash_attention:
+        sp = _sp_axis(cfg)
+        if sp is not None:
+            # Sequence-parallel: q/k/v hold this shard's tokens; attend
+            # globally via the k/v ring (causality handled at block level).
+            from deepspeed_tpu.ops.transformer.ring_attention import (
+                ring_flash_attention)
+            y = ring_flash_attention(q, k, v, axis_name=sp, causal=True)
+            y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        elif cfg.use_flash_attention:
             # Pallas flash kernel: O(T) memory, both GEMMs MXU-resident
             # (ops/transformer/kernels/attention.py). Attention-prob dropout
             # moves to the context output (flash never materializes probs).
@@ -151,7 +180,22 @@ class GPT2LMHeadModel(nn.Module):
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.n_positions, cfg.n_embd), jnp.float32)
 
-        x = wte.astype(cfg.dtype)[input_ids] + wpe.astype(cfg.dtype)[None, :T]
+        sp = _sp_axis(cfg)
+        if sp is not None:
+            # This shard holds tokens [idx*T, (idx+1)*T) of the global
+            # sequence: offset the position table slice. The GLOBAL length
+            # must fit the table — dynamic_slice would silently clamp an
+            # out-of-range start to reuse early positions.
+            assert jax.lax.axis_size(sp) * T <= cfg.n_positions, (
+                "global sequence {} ({} shards x {} local) exceeds "
+                "n_positions={}".format(jax.lax.axis_size(sp) * T,
+                                        jax.lax.axis_size(sp), T,
+                                        cfg.n_positions))
+            pos0 = jax.lax.axis_index(sp) * T
+            pe = jax.lax.dynamic_slice(wpe, (pos0, 0), (T, cfg.n_embd))
+        else:
+            pe = wpe[:T]
+        x = wte.astype(cfg.dtype)[input_ids] + pe.astype(cfg.dtype)[None]
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
         block_cls = Block
@@ -167,6 +211,9 @@ class GPT2LMHeadModel(nn.Module):
             return jnp.einsum("btc,vc->btv", x.astype(jnp.float32),
                               wte.astype(jnp.float32))
 
+        if sp is not None:
+            return _sequence_parallel_xent(x, wte, labels, cfg, sp)
+
         # Next-token prediction: shift inside the loss. The [B,T,V] logits
         # are never materialized — the head GEMM + softmax-xent run in token
         # chunks (bf16 GEMM, fp32 accumulation) with per-chunk remat, cutting
@@ -180,6 +227,35 @@ def _chunked_softmax_xent(x, wte, labels, dtype, chunk=2048):
     supervised; see models/heads.py)."""
     from deepspeed_tpu.models.heads import chunked_tied_softmax_xent
     return chunked_tied_softmax_xent(x, wte, labels, dtype, chunk=chunk)
+
+
+def _sequence_parallel_xent(x, wte, labels, cfg, axis):
+    """Next-token loss under sequence parallelism.
+
+    The label shift crosses shard boundaries: position t predicts label
+    t+1, so each shard needs the FIRST label of the next shard for its
+    last position. One ppermute of a [B, 1] slice provides it; the global
+    last token (next shard is the wrap-around) is excluded via the ignore
+    mask. The mean is globally weighted: (psum of per-shard sums) /
+    (psum of counts) — shards would otherwise be weighted unevenly.
+    """
+    from deepspeed_tpu.models.heads import chunked_tied_softmax_xent
+
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    # Shard i receives shard (i+1)'s first label (source j sends to j-1).
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    nxt = jax.lax.ppermute(labels[:, :1], axis, perm)
+    # Wrap-around delivery to the last shard is meaningless: mask it.
+    nxt = jnp.where(idx == n - 1, -1, nxt.astype(jnp.int32))
+    shifted = jnp.concatenate(
+        [labels[:, 1:].astype(jnp.int32), nxt], axis=1)
+    total, count = chunked_tied_softmax_xent(
+        x, wte, shifted, cfg.dtype, ignore_index=-1,
+        reduction="sum_count")
+    total = jax.lax.psum(total, axis)
+    count = jax.lax.psum(count, axis)
+    return total / jnp.maximum(count, 1.0)
 
 
 def create_model(config=None, **kw):

@@ -15,8 +15,8 @@ import jax.numpy as jnp
 
 
 def chunked_tied_softmax_xent(x, wte, labels, dtype, chunk=2048, bias=None,
-                              ignore_index=None):
-    """Mean token cross-entropy against a tied [V, C] embedding decoder.
+                              ignore_index=None, reduction="mean"):
+    """Token cross-entropy against a tied [V, C] embedding decoder.
 
     Args:
       x: [B, T, C] final hidden states.
@@ -26,7 +26,11 @@ def chunked_tied_softmax_xent(x, wte, labels, dtype, chunk=2048, bias=None,
       dtype: GEMM input dtype (fp32 accumulation regardless).
       chunk: tokens per slice; clamped to the padded token count.
       bias: optional [V] decoder bias (BERT's mlm_bias).
-    Returns: scalar mean loss over supervised tokens.
+      reduction: "mean" returns the scalar mean over supervised tokens;
+        "sum_count" returns (sum, count) so a sequence-parallel caller can
+        psum both before dividing (a local mean would weight shards with
+        different supervised-token counts incorrectly).
+    Returns: scalar mean loss, or (loss_sum, token_count) fp32 scalars.
     """
     b, t, c = x.shape
     n = b * t
@@ -64,4 +68,7 @@ def chunked_tied_softmax_xent(x, wte, labels, dtype, chunk=2048, bias=None,
         return jnp.sum((lse - gold) * vi)
 
     total = jnp.sum(jax.lax.map(one, (xc, lc, vc)))
-    return total / jnp.maximum(jnp.sum(valid), 1.0)
+    count = jnp.sum(valid)
+    if reduction == "sum_count":
+        return total, count
+    return total / jnp.maximum(count, 1.0)

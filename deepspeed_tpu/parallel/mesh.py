@@ -25,28 +25,35 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
+SEQ_AXIS = "seq"
 
 
 def build_mesh(num_dp: Optional[int] = None,
                num_mp: int = 1,
                num_pp: int = 1,
+               num_sp: int = 1,
                devices=None) -> Mesh:
-    """Build a ('pipe','data','model') mesh over the given devices.
+    """Build a ('pipe','data','seq','model') mesh over the given devices.
 
     Axis order puts 'model' innermost so tensor-parallel collectives ride the
-    fastest ICI links, 'pipe' outermost (stage-adjacent transfers are light),
+    fastest ICI links, then 'seq' (ring-attention k/v rotations are the next
+    hottest traffic), 'pipe' outermost (stage-adjacent transfers are light),
     matching the reference's default rank-mapping intent (topology.py:246-249).
+    The 'seq' axis carries sequence (context) parallelism — beyond the
+    reference, which has none in v0.3.10 (SURVEY §0).
     """
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     if num_dp is None:
-        assert n % (num_mp * num_pp) == 0, \
-            "{} devices not divisible by mp={} * pp={}".format(n, num_mp, num_pp)
-        num_dp = n // (num_mp * num_pp)
-    assert num_dp * num_mp * num_pp == n, \
-        "mesh {}x{}x{} != {} devices".format(num_pp, num_dp, num_mp, n)
-    dev_array = np.asarray(devices).reshape(num_pp, num_dp, num_mp)
-    return Mesh(dev_array, (PIPE_AXIS, DATA_AXIS, MODEL_AXIS))
+        assert n % (num_mp * num_pp * num_sp) == 0, \
+            "{} devices not divisible by mp={} * pp={} * sp={}".format(
+                n, num_mp, num_pp, num_sp)
+        num_dp = n // (num_mp * num_pp * num_sp)
+    assert num_dp * num_mp * num_pp * num_sp == n, \
+        "mesh {}x{}x{}x{} != {} devices".format(num_pp, num_dp, num_sp,
+                                                num_mp, n)
+    dev_array = np.asarray(devices).reshape(num_pp, num_dp, num_sp, num_mp)
+    return Mesh(dev_array, (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def default_mesh() -> Mesh:
@@ -63,6 +70,10 @@ def mp_size(mesh: Mesh) -> int:
 
 def pp_size(mesh: Mesh) -> int:
     return mesh.shape.get(PIPE_AXIS, 1)
+
+
+def sp_size(mesh: Mesh) -> int:
+    return mesh.shape.get(SEQ_AXIS, 1)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -167,16 +178,30 @@ def zero_shardings(mesh: Mesh, params, stage: int, tp_rules=None):
     return param_sh, grad_sh, opt_state_sharding
 
 
+def batch_partition_spec(x, dp, sp=1):
+    """PartitionSpec for one batch array: leading axis over 'data' when
+    divisible, second (token) axis over 'seq' when the mesh carries one.
+    The single source of the batch-sharding heuristic — used by
+    shard_batch's device_put AND the engine's shard_map in_specs (sparse
+    grads, sequence parallelism)."""
+    shape = getattr(x, "shape", ())
+    if len(shape) == 0 or shape[0] % dp != 0:
+        return P()
+    if sp > 1 and len(shape) > 1 and shape[1] % sp == 0:
+        return P(DATA_AXIS, SEQ_AXIS)
+    return P(DATA_AXIS)
+
+
 def shard_batch(mesh: Mesh, batch):
-    """device_put a host batch with its leading axis split over 'data'."""
-    if dp_size(mesh) <= 1 and mp_size(mesh) <= 1 and pp_size(mesh) <= 1:
+    """device_put a host batch: leading axis split over 'data', and the
+    second (sequence) axis over 'seq' when the mesh carries one."""
+    if dp_size(mesh) <= 1 and mp_size(mesh) <= 1 and pp_size(mesh) <= 1 \
+            and sp_size(mesh) <= 1:
         return batch
-    sh = batch_sharding(mesh)
+    dp, sp = dp_size(mesh), sp_size(mesh)
 
     def _put(x):
-        if hasattr(x, "shape") and len(x.shape) > 0 and \
-                x.shape[0] % dp_size(mesh) == 0:
-            return jax.device_put(x, sh)
-        return jax.device_put(x, replicated(mesh))
+        return jax.device_put(
+            x, NamedSharding(mesh, batch_partition_spec(x, dp, sp)))
 
     return jax.tree_util.tree_map(_put, batch)

@@ -145,6 +145,18 @@ def get_sparse_gradients_enabled(param_dict):
     return get_scalar_param(param_dict, SPARSE_GRADIENTS, SPARSE_GRADIENTS_DEFAULT)
 
 
+def get_sequence_parallel_enabled(param_dict):
+    sub = param_dict.get(SEQUENCE_PARALLEL, {})
+    return get_scalar_param(sub, SEQUENCE_PARALLEL_ENABLED,
+                            SEQUENCE_PARALLEL_ENABLED_DEFAULT)
+
+
+def get_sequence_parallel_size(param_dict):
+    sub = param_dict.get(SEQUENCE_PARALLEL, {})
+    return get_scalar_param(sub, SEQUENCE_PARALLEL_SIZE,
+                            SEQUENCE_PARALLEL_SIZE_DEFAULT)
+
+
 def get_zero_allow_untested_optimizer(param_dict):
     return get_scalar_param(param_dict,
                             ZERO_ALLOW_UNTESTED_OPTIMIZER,
@@ -566,6 +578,8 @@ class DeepSpeedConfig(object):
         self.prescale_gradients = get_prescale_gradients(param_dict)
         self.gradient_predivide_factor = get_gradient_predivide_factor(param_dict)
         self.sparse_gradients_enabled = get_sparse_gradients_enabled(param_dict)
+        self.sequence_parallel_enabled = get_sequence_parallel_enabled(param_dict)
+        self.sequence_parallel_size = get_sequence_parallel_size(param_dict)
 
         self.zero_config = DeepSpeedZeroConfig(param_dict)
         self.zero_optimization_stage = self.zero_config.stage

@@ -71,6 +71,17 @@ STEPS_PER_PRINT_DEFAULT = 10
 SPARSE_GRADIENTS = "sparse_gradients"
 SPARSE_GRADIENTS_DEFAULT = False
 
+# Sequence (context) parallelism — beyond the reference (v0.3.10 has no
+# sequence parallelism; SURVEY §0). "sequence_parallel": {"enabled": true,
+# "size": N} shards the token dim of every batch over a 'seq' mesh axis;
+# the model must be sequence-shardable (ring attention + offset positions,
+# e.g. GPT2Config(sequence_parallel_axis='seq')).
+SEQUENCE_PARALLEL = "sequence_parallel"
+SEQUENCE_PARALLEL_ENABLED = "enabled"
+SEQUENCE_PARALLEL_ENABLED_DEFAULT = False
+SEQUENCE_PARALLEL_SIZE = "size"
+SEQUENCE_PARALLEL_SIZE_DEFAULT = None
+
 GRADIENT_CLIPPING = "gradient_clipping"
 GRADIENT_CLIPPING_DEFAULT = 0.0
 

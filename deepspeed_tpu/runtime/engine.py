@@ -26,6 +26,7 @@ execution model is JAX-first:
 
 import glob
 import hashlib
+import json
 import os
 import pickle
 
@@ -139,6 +140,13 @@ class DeepSpeedEngine(object):
         self.global_rank = 0
         self.local_rank = getattr(args, "local_rank", 0) if args else 0
 
+        # Sequence parallelism reshapes the mesh (dp x sp), which feeds the
+        # batch triangle (train = micro * gas * dp) — peek at the raw config
+        # BEFORE the full parse validates batch sizes.
+        sp_enabled, sp_size = self._peek_sequence_parallel(args, config_params)
+        if sp_enabled:
+            self._setup_sequence_parallel_mesh(mesh, sp_size)
+
         self._config = self._configure_with_arguments(args, config_params)
         self._do_args_sanity_check(args)
 
@@ -214,6 +222,51 @@ class DeepSpeedEngine(object):
         PipelineEngine overrides this (its executor is dp=1 within stages)."""
         return mesh_lib.dp_size(self.mesh)
 
+    def _peek_sequence_parallel(self, args, config_params):
+        """(enabled, size) from the raw config source, read before the
+        full DeepSpeedConfig parse (see __init__)."""
+        from deepspeed_tpu.runtime.config import (
+            get_sequence_parallel_enabled, get_sequence_parallel_size)
+
+        raw = config_params
+        config_file = getattr(args, "deepspeed_config", None) if args \
+            else None
+        if raw is None and config_file and os.path.isfile(config_file):
+            with open(config_file) as f:
+                raw = json.load(f)
+        if not isinstance(raw, dict):
+            return False, None
+        return (get_sequence_parallel_enabled(raw),
+                get_sequence_parallel_size(raw))
+
+    def _setup_sequence_parallel_mesh(self, user_mesh, size):
+        """Rebuild/validate the mesh for sequence parallelism: the token
+        dim of every batch shards over a 'seq' axis (config
+        "sequence_parallel": {"enabled": true, "size": N}). With a
+        user-provided mesh the axis must already exist at the right size;
+        the default mesh is rebuilt as dp x sp over the same devices."""
+        if user_mesh is not None:
+            have = mesh_lib.sp_size(user_mesh)
+            if have <= 1:
+                raise ValueError(
+                    "sequence_parallel is enabled but the provided mesh "
+                    "has no 'seq' axis (build_mesh(num_sp=...))")
+            if size is not None and size != have:
+                raise ValueError(
+                    "sequence_parallel size {} != mesh 'seq' axis {}"
+                    .format(size, have))
+            return
+        n = len(jax.devices())
+        if size is None:
+            size = n
+        if n % size:
+            raise ValueError(
+                "sequence_parallel size {} does not divide {} devices"
+                .format(size, n))
+        self.mesh = mesh_lib.build_mesh(num_sp=size, num_dp=n // size)
+        self.dp_world_size = self._config_world_size()
+        self.world_size = self.dp_world_size
+
     def _configure_with_arguments(self, args, config_params):
         config_file = getattr(args, "deepspeed_config", None) if args else None
         assert config_file is not None or config_params is not None, \
@@ -264,6 +317,12 @@ class DeepSpeedEngine(object):
 
     def sparse_gradients_enabled(self):
         return self._config.sparse_gradients_enabled
+
+    def sequence_parallel_enabled(self):
+        return self._config.sequence_parallel_enabled
+
+    def sequence_parallel_size(self):
+        return mesh_lib.sp_size(self.mesh)
 
     def zero_optimization(self):
         return self._config.zero_enabled
@@ -707,9 +766,14 @@ class DeepSpeedEngine(object):
             train and self.sparse_gradients_enabled()
             and mesh_lib.dp_size(self.mesh) > 1
             and self._embedding_grad_paths())
+        sp_parallel = bool(self.sequence_parallel_enabled()
+                           and mesh_lib.sp_size(self.mesh) > 1)
+        if sp_parallel and sparse_embed:
+            raise NotImplementedError(
+                "sequence_parallel cannot be combined with sparse_gradients")
         key = (n_args, tuple(sorted(static_kwargs.items())),
                tuple(sorted(traced_keys)), train, self.compute_dtype.__name__,
-               self._grad_constraint is not None, sparse_embed)
+               self._grad_constraint is not None, sparse_embed, sp_parallel)
         if key in self._fwd_bwd_cache:
             return self._fwd_bwd_cache[key]
         grad_constraint = self._grad_constraint
@@ -754,10 +818,96 @@ class DeepSpeedEngine(object):
                 static_kwargs=static_kwargs, cast=cast, apply_fn=apply_fn,
                 accepts_deterministic=accepts_deterministic,
                 grad_constraint=grad_constraint)
+        elif sp_parallel:
+            jitted = self._build_sequence_parallel_fwd_bwd(
+                static_kwargs=static_kwargs, cast=cast, apply_fn=apply_fn,
+                accepts_deterministic=accepts_deterministic,
+                grad_constraint=grad_constraint, train=train)
         else:
             jitted = jax.jit(loss_and_grads)
         self._fwd_bwd_cache[key] = jitted
         return jitted
+
+    def _build_sequence_parallel_fwd_bwd(self, static_kwargs, cast, apply_fn,
+                                         accepts_deterministic,
+                                         grad_constraint, train):
+        """fwd+bwd program with SEQUENCE parallelism: tokens shard over the
+        'seq' mesh axis under shard_map; the model runs on its local token
+        slice (ring attention mixes across shards — the model must be
+        sequence-shardable, e.g. GPT2Config(sequence_parallel_axis='seq')),
+        grads psum over 'seq' and pmean over 'data'. Beyond the reference
+        (v0.3.10 has no sequence parallelism, SURVEY §0)."""
+        from functools import partial
+
+        from jax import shard_map
+
+        mesh = self.mesh
+        dp = mesh_lib.dp_size(mesh)
+        sp = mesh_lib.sp_size(mesh)
+        module_cfg = getattr(self.module, "config", None)
+        if getattr(module_cfg, "sequence_parallel_axis", None) != \
+                mesh_lib.SEQ_AXIS:
+            raise ValueError(
+                "sequence_parallel is enabled but the model is not "
+                "sequence-shardable: its config must set "
+                "sequence_parallel_axis='{}' (attention must mix tokens "
+                "across shards — silently sharding a serial model would "
+                "train a different function)".format(mesh_lib.SEQ_AXIS))
+
+        def loss_and_grads(params, args, traced_kwargs, rng, scale):
+            P_ = jax.sharding.PartitionSpec
+
+            def arg_spec(x):
+                return mesh_lib.batch_partition_spec(x, dp, sp)
+
+            arg_specs = jax.tree_util.tree_map(arg_spec, args)
+            kw_specs = jax.tree_util.tree_map(arg_spec, traced_kwargs)
+
+            @partial(shard_map, mesh=mesh,
+                     in_specs=(P_(), arg_specs, kw_specs, P_(), P_()),
+                     out_specs=(P_(), P_()), check_vma=False)
+            def spmd(params, largs, lkwargs, rng, scale):
+                rng = jax.random.fold_in(
+                    rng, jax.lax.axis_index(mesh_lib.DATA_AXIS) * sp
+                    + jax.lax.axis_index(mesh_lib.SEQ_AXIS))
+
+                def loss_fn(p):
+                    cp = cast(p)
+                    call_kwargs = dict(static_kwargs)
+                    call_kwargs.update(lkwargs)
+                    if train and accepts_deterministic:
+                        call_kwargs.setdefault("deterministic", False)
+                    rngs = {"dropout": rng} if train else {}
+                    out = apply_fn({"params": cp}, *largs,
+                                   rngs=rngs, **call_kwargs)
+                    if isinstance(out, tuple):
+                        raise NotImplementedError(
+                            "sequence_parallel requires the model to "
+                            "return the scalar loss (auxiliary outputs "
+                            "would be silently dropped)")
+                    return out * scale, out
+
+                (_, out), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                # The model's internal psum already made the loss uniform
+                # over 'seq'; average over 'data' for the global batch mean.
+                out = jax.lax.pmean(out, mesh_lib.DATA_AXIS)
+                # Each shard's grad covers only its local token/batch path:
+                # sum over 'seq', mean over 'data' (matching the loss).
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(
+                        jax.lax.psum(g, mesh_lib.SEQ_AXIS),
+                        mesh_lib.DATA_AXIS),
+                    grads)
+                return out, grads
+
+            out, grads = spmd(params, args, traced_kwargs, rng, scale)
+            if grad_constraint is not None:
+                grads = jax.lax.with_sharding_constraint(
+                    grads, grad_constraint)
+            return out, grads
+
+        return jax.jit(loss_and_grads)
 
     def _build_sparse_grad_fwd_bwd(self, static_kwargs, cast, apply_fn,
                                    accepts_deterministic, grad_constraint):
@@ -778,10 +928,7 @@ class DeepSpeedEngine(object):
 
         def loss_and_grads(params, args, traced_kwargs, rng, scale):
             def batch_spec(x):
-                if hasattr(x, "shape") and getattr(x, "ndim", 0) > 0 and \
-                        x.shape[0] % dp == 0:
-                    return jax.sharding.PartitionSpec(mesh_lib.DATA_AXIS)
-                return jax.sharding.PartitionSpec()
+                return mesh_lib.batch_partition_spec(x, dp)
 
             arg_specs = jax.tree_util.tree_map(batch_spec, args)
             kw_specs = jax.tree_util.tree_map(batch_spec, traced_kwargs)
