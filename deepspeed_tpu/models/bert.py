@@ -39,6 +39,12 @@ class BertConfig:
     dtype: Any = jnp.bfloat16
     pre_layer_norm: bool = False
     use_fused_layer: bool = True
+    # Sequence (context) parallelism: mesh axis the token dim shards over
+    # (the engine's "sequence_parallel" config runs the model inside
+    # shard_map with this axis bound). Requires use_fused_layer=False —
+    # the plain encoder path carries the ring attention. See
+    # GPT2Config.sequence_parallel_axis for the mechanism.
+    sequence_parallel_axis: Any = None
 
     @classmethod
     def bert_base(cls, **kw):
@@ -87,6 +93,13 @@ class BertConfig:
         )
 
 
+def _sp_axis(cfg):
+    """The sequence-parallel axis IF bound in the current trace (see
+    parallel/mesh.py:active_sp_axis)."""
+    from deepspeed_tpu.parallel.mesh import active_sp_axis
+    return active_sp_axis(getattr(cfg, "sequence_parallel_axis", None))
+
+
 class BertEmbeddings(nn.Module):
     config: BertConfig
 
@@ -104,7 +117,18 @@ class BertEmbeddings(nn.Module):
         wtt = self.param("token_type_embeddings", ini,
                          (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
         if position_ids is None:
-            position_ids = jnp.arange(t)[None, :]
+            sp = _sp_axis(cfg)
+            if sp is not None:
+                # Token-sharded: this shard holds global positions
+                # [idx*t, (idx+1)*t).
+                n = jax.lax.axis_size(sp)
+                assert n * t <= cfg.max_position_embeddings, (
+                    "global sequence {} exceeds max_position_embeddings={}"
+                    .format(n * t, cfg.max_position_embeddings))
+                position_ids = (jax.lax.axis_index(sp) * t
+                                + jnp.arange(t))[None, :]
+            else:
+                position_ids = jnp.arange(t)[None, :]
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         x = (wte[input_ids] + wpe[position_ids] + wtt[token_type_ids])
@@ -132,13 +156,27 @@ class PlainBertLayer(nn.Module):
         q = heads(nn.Dense(h, dtype=cfg.dtype, name="query")(x))
         k = heads(nn.Dense(h, dtype=cfg.dtype, name="key")(x))
         v = heads(nn.Dense(h, dtype=cfg.dtype, name="value")(x))
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(hd).astype(cfg.dtype)
-        if add_mask is not None:
-            s = s + add_mask[:, None, None, :].astype(s.dtype)
-        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(cfg.dtype)
-        p = nn.Dropout(cfg.attention_probs_dropout_prob)(
-            p, deterministic=deterministic)
-        ctx = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        sp = _sp_axis(cfg)
+        if sp is not None:
+            # Token-sharded: attend globally via the k/v ring; the local
+            # key-padding mask rotates with its block. Attention-prob
+            # dropout moves to the context output (the ring/flash path
+            # never materializes probs — same policy as GPT-2's flash).
+            from deepspeed_tpu.ops.transformer.ring_attention import (
+                ring_flash_attention)
+            ctx = ring_flash_attention(q, k, v, axis_name=sp,
+                                       mask=add_mask)
+            ctx = nn.Dropout(cfg.attention_probs_dropout_prob)(
+                ctx, deterministic=deterministic)
+        else:
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / \
+                jnp.sqrt(hd).astype(cfg.dtype)
+            if add_mask is not None:
+                s = s + add_mask[:, None, None, :].astype(s.dtype)
+            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+            p = nn.Dropout(cfg.attention_probs_dropout_prob)(
+                p, deterministic=deterministic)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", p, v)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, t, h)
         a = nn.Dense(h, dtype=cfg.dtype, name="attn_out")(ctx)
         a = nn.Dropout(cfg.hidden_dropout_prob)(a, deterministic=deterministic)
@@ -172,6 +210,12 @@ class BertModel(nn.Module):
             # (0 keep / large-negative drop, [B, T]).
             add_mask = (1.0 - attention_mask.astype(jnp.float32)) * -1e9
 
+        sp = _sp_axis(cfg)
+        if sp is not None and cfg.use_fused_layer:
+            raise ValueError(
+                "sequence_parallel BERT requires use_fused_layer=False "
+                "(the plain encoder path carries the ring attention)")
+
         layer_cfg = cfg._ds_layer_config(training=not deterministic)
         for i in range(cfg.num_hidden_layers):
             if cfg.use_fused_layer:
@@ -183,8 +227,16 @@ class BertModel(nn.Module):
                 x = PlainBertLayer(cfg, name="layer_{}".format(i))(
                     x, add_mask, deterministic=deterministic)
 
+        if sp is not None:
+            # [CLS] (global token 0) lives on shard 0 only; every shard
+            # needs the pooled vector (replicated) for the NSP head.
+            cls = jnp.where(jax.lax.axis_index(sp) == 0,
+                            x[:, 0].astype(jnp.float32), 0.0)
+            cls = jax.lax.psum(cls, sp).astype(cfg.dtype)
+        else:
+            cls = x[:, 0]
         pooled = nn.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
-                                  name="pooler")(x[:, 0]))
+                                  name="pooler")(cls))
         return x, pooled, wte
 
 
@@ -230,16 +282,39 @@ class BertForPreTraining(nn.Module):
             prediction_logits = h @ wte.T.astype(jnp.float32) + mlm_bias
             return prediction_logits, seq_relationship
 
+        sp = _sp_axis(cfg)
         total = 0.0
         if masked_lm_labels is not None:
             # Chunked masked-LM loss: the [B, T, V] fp32 logits never
             # materialize (the GPT-2 head's chunking, gpt2.py:178, with
             # BERT's -1-ignore labels and decoder bias).
-            total = total + _chunked_mlm_xent(h, wte, mlm_bias,
-                                              masked_lm_labels, cfg.dtype)
+            if sp is not None:
+                # Token-sharded: globally count-weighted mean (shards hold
+                # different numbers of masked positions).
+                from deepspeed_tpu.models.heads import (
+                    chunked_tied_softmax_xent)
+                mlm_sum, mlm_count = chunked_tied_softmax_xent(
+                    h, wte, masked_lm_labels, cfg.dtype, bias=mlm_bias,
+                    ignore_index=-1, reduction="sum_count")
+                total = total + jax.lax.psum(mlm_sum, sp) / jnp.maximum(
+                    jax.lax.psum(mlm_count, sp), 1.0)
+            else:
+                total = total + _chunked_mlm_xent(h, wte, mlm_bias,
+                                                  masked_lm_labels,
+                                                  cfg.dtype)
         if next_sentence_label is not None:
             logp = jax.nn.log_softmax(seq_relationship, axis=-1)
             nll = -jnp.take_along_axis(
                 logp, next_sentence_label[..., None], axis=-1)[..., 0]
-            total = total + jnp.mean(nll)
+            nsp = jnp.mean(nll)
+            if sp is not None:
+                # The NSP branch is computed identically on EVERY shard
+                # (pooled is replicated after its psum), so its local
+                # gradients are each the FULL gradient. The engine sums
+                # grads over 'seq': psum(nsp / n) keeps the value exact
+                # and scales the per-shard grad by 1/n so the sum counts
+                # the branch once.
+                n = jax.lax.axis_size(sp)
+                nsp = jax.lax.psum(nsp / n, sp)
+            total = total + nsp
         return total

@@ -80,14 +80,8 @@ class GPT2Config:
 def _sp_axis(cfg):
     """The sequence-parallel axis name IF the model is being traced inside
     a shard_map that binds it; None otherwise (init / serial eval)."""
-    axis = getattr(cfg, "sequence_parallel_axis", None)
-    if axis is None:
-        return None
-    try:
-        jax.lax.axis_index(axis)
-    except NameError:
-        return None
-    return axis
+    from deepspeed_tpu.parallel.mesh import active_sp_axis
+    return active_sp_axis(getattr(cfg, "sequence_parallel_axis", None))
 
 
 class CausalSelfAttention(nn.Module):

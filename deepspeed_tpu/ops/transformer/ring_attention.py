@@ -19,9 +19,11 @@ Design notes:
   which is exactly the flash online-softmax update at ring granularity.
 - Causality is decided at BLOCK level from the ring step: source block j
   attends destination block i fully when j < i, causally (diagonal) when
-  j == i, and not at all when j > i — the skipped blocks contribute a
-  -inf lse, making the merge a no-op. The local kernel therefore only
-  needs causal masking on the diagonal step.
+  j == i, and not at all when j > i — the skipped blocks never run a
+  kernel (lax.cond on the uniform ring counter) and contribute a NEG_INF
+  lse, making the merge a no-op.
+- An additive key padding mask ([B, T] over GLOBAL key positions, sharded
+  like k/v) rotates around the ring alongside its k/v block.
 - The backward pass needs no hand-written collective: the merge is
   differentiable jnp, the per-block kernel has its custom_vjp, and
   ppermute's transpose is the reverse permute — `jax.lax.scan` over ring
@@ -54,8 +56,8 @@ def _merge(o_a, lse_a, o_b, lse_b):
     return o, m + jnp.log(denom)
 
 
-def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
-                         block_q=None, block_k=None):
+def ring_flash_attention(q, k, v, axis_name, causal=False, mask=None,
+                         scale=None, block_q=None, block_k=None):
     """Flash attention over sequence shards on a ring. SPMD-collective:
     must run inside shard_map (or pmap) with ``axis_name`` bound, with
     q/k/v sequence dims sharded over that axis.
@@ -65,6 +67,9 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
       axis_name: mesh axis the sequence is sharded over.
       causal: causal masking in GLOBAL sequence positions (shards are
         assumed laid out in axis-index order).
+      mask: optional additive key padding mask shard [B, T_local]
+        (0 keep / -1e9 drop), covering this shard's KEY positions; it
+        rotates with the k/v blocks.
       scale: score scale; default 1/sqrt(D).
       block_q/block_k: Pallas tile sizes for the local kernel.
     Returns: [B, H, T_local, D] in q.dtype.
@@ -76,31 +81,37 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
 
     if n == 1:
         return flash_attention_with_lse(
-            q, k, v, causal=causal, scale=scale, block_q=block_q,
-            block_k=block_k)[0]
+            q, k, v, mask=mask, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k)[0]
 
     b, h, t_local, _ = q.shape
     o0 = jnp.zeros((b, h, t_local, d), jnp.float32)
     lse0 = jnp.full((b, h, t_local, 1), NEG_INF, jnp.float32)
+    has_mask = mask is not None
+    # The mask occupies a scan-carry slot (rotating with its k/v block)
+    # only when present — a dead zeros-mask would cost one extra ppermute
+    # per ring step per layer.
+    mask_carry = (mask.astype(jnp.float32),) if has_mask else ()
     # Ring neighbour: receive from the previous rank, send to the next, so
     # at step s the local device holds k/v block (my - s) mod n.
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, s):
-        o, lse, k_blk, v_blk = carry
+        o, lse, k_blk, v_blk = carry[:4]
+        cur_mask = carry[4] if has_mask else None
         src = (my - s) % n
 
         def full_block():
             oc, lc = flash_attention_with_lse(
-                q, k_blk, v_blk, causal=False, scale=scale,
+                q, k_blk, v_blk, mask=cur_mask, causal=False, scale=scale,
                 block_q=block_q, block_k=block_k)
             return oc.astype(jnp.float32), lc
 
         if causal:
             def diag_block():
                 od, ld = flash_attention_with_lse(
-                    q, k_blk, v_blk, causal=True, scale=scale,
-                    block_q=block_q, block_k=block_k)
+                    q, k_blk, v_blk, mask=cur_mask, causal=True,
+                    scale=scale, block_q=block_q, block_k=block_k)
                 return od.astype(jnp.float32), ld
 
             def skipped_block():
@@ -115,39 +126,44 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
             o_p, lse_p = full_block()
         o, lse = _merge(o, lse, o_p, lse_p)
 
-        # Rotate k/v for the next step. The final step's rotation would be
-        # discarded — skip it (the predicate is the scan counter, identical
-        # on every device, so the collective stays globally consistent).
-        def rotate(kv):
-            k_b, v_b = kv
-            return (jax.lax.ppermute(k_b, axis_name, perm),
-                    jax.lax.ppermute(v_b, axis_name, perm))
+        # Rotate k/v (+mask) for the next step. The final step's rotation
+        # would be discarded — skip it (the predicate is the scan counter,
+        # identical on every device, so the collective stays globally
+        # consistent).
+        def rotate(kvm):
+            return tuple(jax.lax.ppermute(x, axis_name, perm) for x in kvm)
 
-        k_blk, v_blk = jax.lax.cond(s < n - 1, rotate, lambda kv: kv,
-                                    (k_blk, v_blk))
-        return (o, lse, k_blk, v_blk), None
+        rolling = (k_blk, v_blk) + ((cur_mask,) if has_mask else ())
+        rolling = jax.lax.cond(s < n - 1, rotate, lambda kvm: kvm, rolling)
+        return (o, lse) + rolling, None
 
-    (o, lse, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v),
-                                     jnp.arange(n))
+    (o, lse, *_), _ = jax.lax.scan(step, (o0, lse0, k, v) + mask_carry,
+                                   jnp.arange(n))
     return o.astype(q.dtype)
 
 
 def sequence_parallel_attention(mesh, q, k, v, axis_name="data",
-                                causal=False, scale=None, block_q=None,
-                                block_k=None):
+                                causal=False, mask=None, scale=None,
+                                block_q=None, block_k=None):
     """shard_map wrapper: q/k/v are GLOBAL [B, H, T, D] arrays (or host
     numpy); the sequence dim is sharded over ``axis_name`` and attention
-    runs as a ring. Batch/head dims stay replicated here — compose with
+    runs as a ring. ``mask`` is the GLOBAL [B, T] additive key padding
+    mask. Batch/head dims stay replicated here — compose with
     data-parallel batch sharding by calling ring_flash_attention directly
     inside your own shard_map."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, None, axis_name, None)
-    fn = shard_map(
-        functools.partial(ring_flash_attention, axis_name=axis_name,
-                          causal=causal, scale=scale, block_q=block_q,
-                          block_k=block_k),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    return fn(q, k, v)
+    ring = functools.partial(ring_flash_attention, axis_name=axis_name,
+                             causal=causal, scale=scale, block_q=block_q,
+                             block_k=block_k)
+    if mask is None:
+        fn = shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+        return fn(q, k, v)
+    fn = shard_map(lambda q, k, v, m: ring(q, k, v, mask=m),
+                   mesh=mesh,
+                   in_specs=(spec, spec, spec, P(None, axis_name)),
+                   out_specs=spec, check_vma=False)
+    return fn(q, k, v, mask)

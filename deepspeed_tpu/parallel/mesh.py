@@ -178,6 +178,20 @@ def zero_shardings(mesh: Mesh, params, stage: int, tp_rules=None):
     return param_sh, grad_sh, opt_state_sharding
 
 
+def active_sp_axis(axis_name):
+    """``axis_name`` IF the caller is being traced inside a shard_map that
+    binds it; None otherwise. Lets a model switch to its sequence-parallel
+    paths (ring attention, offset positions, psum'd losses) only when it
+    actually runs token-sharded — init and serial eval stay untouched."""
+    if axis_name is None:
+        return None
+    try:
+        jax.lax.axis_index(axis_name)
+    except NameError:
+        return None
+    return axis_name
+
+
 def batch_partition_spec(x, dp, sp=1):
     """PartitionSpec for one batch array: leading axis over 'data' when
     divisible, second (token) axis over 'seq' when the mesh carries one.

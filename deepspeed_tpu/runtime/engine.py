@@ -767,7 +767,9 @@ class DeepSpeedEngine(object):
             and mesh_lib.dp_size(self.mesh) > 1
             and self._embedding_grad_paths())
         sp_parallel = bool(self.sequence_parallel_enabled()
-                           and mesh_lib.sp_size(self.mesh) > 1)
+                           and mesh_lib.sp_size(self.mesh) > 1
+                           and not getattr(self, "_force_serial_fwd_bwd",
+                                           False))
         if sp_parallel and sparse_embed:
             raise NotImplementedError(
                 "sequence_parallel cannot be combined with sparse_gradients")
@@ -856,6 +858,27 @@ class DeepSpeedEngine(object):
 
         def loss_and_grads(params, args, traced_kwargs, rng, scale):
             P_ = jax.sharding.PartitionSpec
+
+            def check(x):
+                # Silent down-sharding would run the model's SP paths on
+                # wrong decompositions (full sequences treated as shards,
+                # or non-token dims sliced): every batch array must split
+                # exactly — batch over dp, and tokens (dim 1 of any rank>=2
+                # array) over sp.
+                shape = getattr(x, "shape", ())
+                if len(shape) >= 1 and shape[0] % dp:
+                    raise ValueError(
+                        "sequence_parallel: batch dim {} of shape {} not "
+                        "divisible by dp={}".format(shape[0], shape, dp))
+                if len(shape) >= 2 and shape[1] % sp:
+                    raise ValueError(
+                        "sequence_parallel: token dim {} of shape {} not "
+                        "divisible by sp={} (all rank>=2 batch arrays are "
+                        "token-sharded on dim 1)".format(
+                            shape[1], shape, sp))
+                return x
+
+            jax.tree_util.tree_map(check, (args, traced_kwargs))
 
             def arg_spec(x):
                 return mesh_lib.batch_partition_spec(x, dp, sp)
@@ -1086,6 +1109,11 @@ class DeepSpeedEngine(object):
         saved_dtype = self.compute_dtype
         self._grad_constraint = None
         self.compute_dtype = jnp.float32
+        # Force the plain (non-shard_map) program: under sequence
+        # parallelism the reference must be the SERIAL function — building
+        # the same SP decomposition twice would make the comparison
+        # vacuous (an SP-specific psum/label-shift bug matches itself).
+        self._force_serial_fwd_bwd = True
         try:
             ref_fn = self._get_fwd_bwd(len(inputs), static_kwargs,
                                        traced_kwargs.keys(), True)
@@ -1097,6 +1125,7 @@ class DeepSpeedEngine(object):
         finally:
             self._grad_constraint = saved_constraint
             self.compute_dtype = saved_dtype
+            self._force_serial_fwd_bwd = False
         tol = 2e-2 if saved_dtype != jnp.float32 else 1e-4
         for (path, a), b in zip(
                 jax.tree_util.tree_flatten_with_path(sharded_grads)[0],

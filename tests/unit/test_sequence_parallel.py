@@ -81,6 +81,22 @@ def test_sp_composes_with_zero2():
     np.testing.assert_allclose(sp, serial, rtol=5e-2, atol=5e-2)
 
 
+def test_sp_rejects_indivisible_token_dim():
+    """A token dim not divisible by sp must raise — silent down-sharding
+    would run the SP model paths on a wrong decomposition."""
+    cfg = GPT2Config.tiny(dropout=0.0, sequence_parallel_axis="seq")
+    engine, _, _, _ = deepspeed.initialize(
+        model=GPT2LMHeadModel(cfg),
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "sequence_parallel": {"enabled": True, "size": 8},
+        })
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, size=(8, 33))
+    with pytest.raises(ValueError, match="not\\s+divisible by sp"):
+        engine(ids, ids)
+
+
 def test_sp_requires_sequence_shardable_model():
     """A model without sequence_parallel_axis must be rejected loudly —
     sharding a serial model's tokens would train a different function."""
@@ -101,6 +117,70 @@ def test_sp_user_mesh_must_have_seq_axis():
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
                 "sequence_parallel": {"enabled": True},
             })
+
+
+def test_bert_sp_loss_matches_serial():
+    """BERT MLM+NSP under sp=8 reproduces the serial loss (encoder ring
+    attention with a rotating padding mask, psum'd MLM mean, [CLS]
+    broadcast for the NSP head)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
+
+    def run(sp):
+        cfg = BertConfig.tiny(hidden_dropout_prob=0.0,
+                              attention_probs_dropout_prob=0.0,
+                              use_fused_layer=False,
+                              dtype=jnp.float32,
+                              sequence_parallel_axis="seq" if sp else None)
+        config = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        }
+        if sp:
+            config["sequence_parallel"] = {"enabled": True, "size": 8}
+        engine, _, _, _ = deepspeed.initialize(
+            model=BertForPreTraining(cfg), config_params=config)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, size=(8, 32))
+        attn_mask = (rng.rand(8, 32) > 0.1).astype(np.int32)
+        attn_mask[:, 0] = 1  # keep [CLS]
+        labels = np.where(rng.rand(8, 32) < 0.15, ids, -1)
+        nsp = rng.randint(0, 2, size=(8,))
+        losses = []
+        for _ in range(3):
+            loss = engine(ids, jnp.asarray(attn_mask), None,
+                          jnp.asarray(labels), jnp.asarray(nsp))
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        return losses
+
+    serial = run(False)
+    sp = run(True)
+    np.testing.assert_allclose(sp[0], serial[0], rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(sp, serial, rtol=1e-2, atol=1e-2)
+
+
+def test_bert_sp_rejects_fused_layer():
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
+
+    cfg = BertConfig.tiny(use_fused_layer=True,
+                          sequence_parallel_axis="seq")
+    engine, _, _, _ = deepspeed.initialize(
+        model=BertForPreTraining(cfg),
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "sequence_parallel": {"enabled": True, "size": 8},
+        })
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, size=(8, 32))
+    labels = np.full((8, 32), -1)
+    labels[:, ::4] = 1
+    with pytest.raises(ValueError, match="use_fused_layer"):
+        engine(ids, None, None, jnp.asarray(labels), None)
 
 
 def test_sp_eval_loss_matches_train_function():
