@@ -308,12 +308,13 @@ class BertForPreTraining(nn.Module):
                 logp, next_sentence_label[..., None], axis=-1)[..., 0]
             nsp = jnp.mean(nll)
             if sp is not None:
-                # The NSP branch is computed identically on EVERY shard
-                # (pooled is replicated after its psum), so its local
-                # gradients are each the FULL gradient. The engine sums
-                # grads over 'seq': psum(nsp / n) keeps the value exact
-                # and scales the per-shard grad by 1/n so the sum counts
-                # the branch once.
+                # Keep the value an explicit cross-shard reduction (every
+                # shard computes the identical scalar through the
+                # replicated pooled vector): psum(nsp / n) == nsp. Under
+                # shard_map's collective-aware autodiff the gradient is
+                # the same with or without this — the engine pmean's
+                # grads over 'seq' — but the psum makes the replication
+                # visible to vma checks and readers.
                 n = jax.lax.axis_size(sp)
                 nsp = jax.lax.psum(nsp / n, sp)
             total = total + nsp

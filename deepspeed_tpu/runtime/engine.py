@@ -915,11 +915,18 @@ class DeepSpeedEngine(object):
                 # The model's internal psum already made the loss uniform
                 # over 'seq'; average over 'data' for the global batch mean.
                 out = jax.lax.pmean(out, mesh_lib.DATA_AXIS)
-                # Each shard's grad covers only its local token/batch path:
-                # sum over 'seq', mean over 'data' (matching the loss).
+                # shard_map autodiff is collective-aware: differentiating
+                # THROUGH the model's psum/ppermute ties the shards, so
+                # each device's grad is already the FULL gradient of its
+                # data-shard's loss (psum's transpose is psum) — pmean
+                # over 'seq' (deduplicate), pmean over 'data' (global
+                # batch mean). A psum over 'seq' here would scale grads by
+                # sp — invisible to Adam (scale-invariant) but wrong for
+                # clipping/SGD; pg_correctness_test now guards this
+                # against the forced-serial reference.
                 grads = jax.tree_util.tree_map(
                     lambda g: jax.lax.pmean(
-                        jax.lax.psum(g, mesh_lib.SEQ_AXIS),
+                        jax.lax.pmean(g, mesh_lib.SEQ_AXIS),
                         mesh_lib.DATA_AXIS),
                     grads)
                 return out, grads
@@ -1127,6 +1134,13 @@ class DeepSpeedEngine(object):
             self.compute_dtype = saved_dtype
             self._force_serial_fwd_bwd = False
         tol = 2e-2 if saved_dtype != jnp.float32 else 1e-4
+        if self.sequence_parallel_enabled() and \
+                mesh_lib.sp_size(self.mesh) > 1:
+            # SP is a genuinely different decomposition (ring-merge
+            # softmax vs one-block attention): fp32 rounding scatter
+            # reaches ~1e-3 elementwise while gradient NORMS agree to
+            # ~0.1% — an sp-times scale bug still exceeds this by ~8x.
+            tol = max(tol, 5e-3)
         for (path, a), b in zip(
                 jax.tree_util.tree_flatten_with_path(sharded_grads)[0],
                 jax.tree_util.tree_leaves(ref_grads)):
