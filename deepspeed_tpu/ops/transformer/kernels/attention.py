@@ -330,7 +330,10 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, has_mask,
     def ds_block():
         s = _bwd_scores(q_ref, k_ref, mask_ref, tril_ref, iq, j, causal,
                         block_q, block_k)
-        p = jnp.exp(s - lse_ref[0, 0])                     # [bq, bk] fp32
+        # s <= lse mathematically; clamping guards fully-masked rows where
+        # fp32 lse (~mask magnitude, ulp 64) loses the log-sum bits and a
+        # spurious positive exponent would poison the step with inf grads.
+        p = jnp.exp(jnp.minimum(s - lse_ref[0, 0], 0.0))   # [bq, bk] fp32
         v_blk = v_ref[0, 0]
         do = do_ref[0, 0]
         dp = jax.lax.dot_general(do.astype(v_blk.dtype), v_blk,
@@ -376,7 +379,10 @@ def _bwd_dkv_kernel(*refs, causal, block_q, block_k, has_mask, has_tril,
     def grads_block():
         s = _bwd_scores(q_ref, k_ref, mask_ref, tril_ref, i, jk, causal,
                         block_q, block_k)
-        p = jnp.exp(s - lse_ref[0, 0])                     # [bq, bk] fp32
+        # s <= lse mathematically; clamping guards fully-masked rows where
+        # fp32 lse (~mask magnitude, ulp 64) loses the log-sum bits and a
+        # spurious positive exponent would poison the step with inf grads.
+        p = jnp.exp(jnp.minimum(s - lse_ref[0, 0], 0.0))   # [bq, bk] fp32
         do = do_ref[0, 0]
         p_cast = p.astype(do.dtype)
         dv = jax.lax.dot_general(p_cast, do, (((0,), (0,)), ((), ())),
@@ -718,12 +724,9 @@ def flash_attention_with_lse(q, k, v, mask=None, causal=False, scale=None,
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    t_q, t_kv = q.shape[2], k.shape[2]
-    if block_q is None and block_k is None and not _interpret():
-        block_q, block_k = _autotuned_blocks(q, k, v, causal, 1024, 1024)
-    block_q = min(int(block_q or 1024), t_q)
-    block_k = min(int(block_k or 1024), t_kv)
-    if t_q % block_q or t_kv % block_k:
+    block_q, block_k, ragged = resolve_block_sizes(q, k, v, causal,
+                                                   block_q, block_k)
+    if ragged:
         return mha_reference(q, k, v, mask=mask, causal=causal,
                              scale=scale, return_lse=True)
     return _flash_attention_lse(q, k, v, mask, float(scale), bool(causal),
@@ -788,6 +791,23 @@ def _autotuned_blocks(q, k, v, causal, default_q, default_k):
     return int(choice[0]), int(choice[1])
 
 
+def resolve_block_sizes(q, k, v, causal, block_q, block_k,
+                        default_q=1024, default_k=1024):
+    """(block_q, block_k, ragged) — the ONE block-selection policy shared
+    by flash_attention, flash_attention_with_lse and ring attention:
+    consult the per-shape autotuner when no explicit tiles were given (on
+    TPU), default otherwise, clamp to the sequence extents, and flag
+    shapes the tiled kernels cannot take (ragged => dense fallback)."""
+    t_q, t_kv = q.shape[2], k.shape[2]
+    if block_q is None and block_k is None and not _interpret():
+        block_q, block_k = _autotuned_blocks(q, k, v, causal,
+                                             default_q, default_k)
+    bq = min(int(block_q or default_q), t_q)
+    bk = min(int(block_k or default_k), t_kv)
+    ragged = bool(t_q % bq or t_kv % bk)
+    return bq, bk, ragged
+
+
 def flash_attention(q, k, v, mask=None, causal=False, scale=None,
                     block_q=None, block_k=None):
     """Fused (flash) multi-head attention.
@@ -807,15 +827,9 @@ def flash_attention(q, k, v, mask=None, causal=False, scale=None,
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    t_q, t_kv = q.shape[2], k.shape[2]
-    if block_q is None and block_k is None and not _interpret():
-        block_q, block_k = _autotuned_blocks(q, k, v, causal, 1024, 1024)
-    else:
-        block_q = block_q if block_q is not None else 1024
-        block_k = block_k if block_k is not None else 1024
-    block_q = min(int(block_q), t_q)
-    block_k = min(int(block_k), t_kv)
-    if t_q % block_q or t_kv % block_k:
+    block_q, block_k, ragged = resolve_block_sizes(q, k, v, causal,
+                                                   block_q, block_k)
+    if ragged:
         # Kernel reads fixed-size VMEM slices; ragged tails go to the
         # (differentiable) jnp path. Pad sequences to the block size to stay
         # on the fused kernel (SparseAttentionUtils.pad_to_block_size is the
