@@ -299,7 +299,11 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, mask=None,
             q, k, v, mask=mask, causal=causal, scale=scale,
             block_q=block_q, block_k=block_k)[0]
 
-    bq, bk, dense = resolve_block_sizes(q, k, v, causal, block_q, block_k)
+    # Tile lookup keys the NON-causal autotuner entry: in a causal ring
+    # n-1 of the n block kernels are the full (non-causal) variant — the
+    # diagonal causal call is the minority. The semantic causal flag is
+    # passed to the kernels unchanged.
+    bq, bk, dense = resolve_block_sizes(q, k, v, False, block_q, block_k)
     mask_f = None if mask is None else mask.astype(jnp.float32)
     return _ring(q, k, v, mask_f, axis_name, bool(causal), scale, bq, bk,
                  dense)
