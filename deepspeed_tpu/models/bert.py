@@ -45,6 +45,8 @@ class BertConfig:
     # the plain encoder path carries the ring attention. See
     # GPT2Config.sequence_parallel_axis for the mechanism.
     sequence_parallel_axis: Any = None
+    # "ring" or "ulysses" (see GPT2Config.sequence_parallel_mode).
+    sequence_parallel_mode: str = "ring"
 
     @classmethod
     def bert_base(cls, **kw):
@@ -158,14 +160,15 @@ class PlainBertLayer(nn.Module):
         v = heads(nn.Dense(h, dtype=cfg.dtype, name="value")(x))
         sp = _sp_axis(cfg)
         if sp is not None:
-            # Token-sharded: attend globally via the k/v ring; the local
-            # key-padding mask rotates with its block. Attention-prob
-            # dropout moves to the context output (the ring/flash path
-            # never materializes probs — same policy as GPT-2's flash).
+            # Token-sharded: attend globally via the k/v ring (local
+            # key-padding mask rotates with its block) or Ulysses
+            # all-to-all head swaps. Attention-prob dropout moves to the
+            # context output (the ring/flash path never materializes
+            # probs — same policy as GPT-2's flash).
             from deepspeed_tpu.ops.transformer.ring_attention import (
-                ring_flash_attention)
-            ctx = ring_flash_attention(q, k, v, axis_name=sp,
-                                       mask=add_mask)
+                get_sp_attention)
+            sp_attn = get_sp_attention(cfg.sequence_parallel_mode)
+            ctx = sp_attn(q, k, v, axis_name=sp, mask=add_mask)
             ctx = nn.Dropout(cfg.attention_probs_dropout_prob)(
                 ctx, deterministic=deterministic)
         else:

@@ -40,11 +40,15 @@ class GPT2Config:
     # Sequence (context) parallelism: name of the mesh axis the sequence
     # dim is sharded over. When set AND the model runs inside shard_map
     # with that axis bound (the engine's sequence_parallel config does
-    # this), positions are offset per shard, attention runs as a ring
-    # (ops/transformer/ring_attention.py), and the loss is globally
-    # averaged via psum. Outside shard_map the model behaves normally, so
-    # init/eval on the full sequence work unchanged.
+    # this), positions are offset per shard, attention mixes tokens
+    # across shards (ops/transformer/ring_attention.py), and the loss is
+    # globally averaged via psum. Outside shard_map the model behaves
+    # normally, so init/eval on the full sequence work unchanged.
     sequence_parallel_axis: Any = None
+    # "ring" (k/v rotation, O(T/N) memory, any shard count) or "ulysses"
+    # (two all_to_alls swapping token<->head sharding; needs
+    # n_head % shards == 0; cheaper collectives for small shard counts).
+    sequence_parallel_mode: str = "ring"
 
     @classmethod
     def gpt2_small(cls, **kw):
@@ -103,10 +107,12 @@ class CausalSelfAttention(nn.Module):
         sp = _sp_axis(cfg)
         if sp is not None:
             # Sequence-parallel: q/k/v hold this shard's tokens; attend
-            # globally via the k/v ring (causality handled at block level).
+            # globally via the k/v ring (causality handled at block level)
+            # or Ulysses all-to-all head swaps, per config.
             from deepspeed_tpu.ops.transformer.ring_attention import (
-                ring_flash_attention)
-            y = ring_flash_attention(q, k, v, axis_name=sp, causal=True)
+                get_sp_attention)
+            sp_attn = get_sp_attention(cfg.sequence_parallel_mode)
+            y = sp_attn(q, k, v, axis_name=sp, causal=True)
             y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
         elif cfg.use_flash_attention:
             # Pallas flash kernel: O(T) memory, both GEMMs MXU-resident

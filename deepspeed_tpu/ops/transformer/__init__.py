@@ -3,7 +3,7 @@
 from deepspeed_tpu.ops.transformer.kernels.attention import (  # noqa: F401
     flash_attention, flash_attention_with_lse)
 from deepspeed_tpu.ops.transformer.ring_attention import (  # noqa: F401
-    ring_flash_attention, sequence_parallel_attention)
+    ring_flash_attention, sequence_parallel_attention, ulysses_attention)
 from deepspeed_tpu.ops.transformer.transformer import (  # noqa: F401
     DeepSpeedTransformerConfig, DeepSpeedTransformerLayer,
     transformer_layer_reference)

@@ -334,3 +334,71 @@ def sequence_parallel_attention(mesh, q, k, v, axis_name="data",
                    in_specs=(spec, spec, spec, P(None, axis_name)),
                    out_specs=spec, check_vma=False)
     return fn(q, k, v, mask)
+
+
+def get_sp_attention(mode):
+    """Resolve a sequence_parallel_mode string to its attention
+    implementation; unknown modes raise instead of silently running a
+    different collective pattern."""
+    impls = {"ring": ring_flash_attention, "ulysses": ulysses_attention}
+    try:
+        return impls[mode]
+    except KeyError:
+        raise ValueError(
+            "unknown sequence_parallel_mode {!r}; expected one of {}"
+            .format(mode, sorted(impls))) from None
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, mask=None,
+                      scale=None, block_q=None, block_k=None):
+    """All-to-all (DeepSpeed-Ulysses-style) sequence-parallel attention —
+    the other classic context-parallel decomposition, complementing the
+    ring: two ``jax.lax.all_to_all`` exchanges swap the TOKEN sharding for
+    a HEAD sharding, each device runs ordinary full-sequence flash
+    attention for its H/N head subset, and the reverse exchange restores
+    token sharding. Versus the ring: 2 all-to-alls instead of N-1
+    ppermutes (better for small N / fast ICI), but requires num_heads
+    divisible by the axis size and materializes the full sequence per
+    device (memory O(T·H/N) instead of O(T/N·H)).
+
+    SPMD-collective: call inside shard_map with ``axis_name`` bound.
+
+    Args:
+      q, k, v: [B, H, T_local, D] — the local sequence shard.
+      axis_name: mesh axis the sequence is sharded over.
+      causal: causal masking (global positions).
+      mask: optional additive key padding mask shard [B, T_local]
+        (gathered to the full [B, T] for the local attention).
+      scale, block_q, block_k: forwarded to flash_attention.
+    Returns: [B, H, T_local, D] in q.dtype.
+    """
+    from deepspeed_tpu.ops.transformer.kernels.attention import (
+        flash_attention)
+
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return flash_attention(q, k, v, mask=mask, causal=causal,
+                               scale=scale, block_q=block_q,
+                               block_k=block_k)
+    h = q.shape[1]
+    if h % n:
+        raise ValueError(
+            "ulysses_attention requires num_heads ({}) divisible by the "
+            "'{}' axis size ({}); use ring attention for more shards "
+            "than heads".format(h, axis_name, n))
+
+    def to_heads(x):     # [B, H, T/n, D] -> [B, H/n, T, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    def to_tokens(x):    # [B, H/n, T, D] -> [B, H, T/n, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    full_mask = None
+    if mask is not None:
+        full_mask = jax.lax.all_gather(mask, axis_name, axis=1, tiled=True)
+    o = flash_attention(to_heads(q), to_heads(k), to_heads(v),
+                        mask=full_mask, causal=causal, scale=scale,
+                        block_q=block_q, block_k=block_k)
+    return to_tokens(o)

@@ -69,6 +69,34 @@ def test_sp_composes_with_dp():
     np.testing.assert_allclose(sp, serial, rtol=1e-2, atol=1e-2)
 
 
+def test_sp_ulysses_mode_matches_serial():
+    """sequence_parallel_mode='ulysses' (all-to-all head swaps) through
+    the engine: sp=4 x dp=2, 4 heads — tracks the serial curve like the
+    ring mode."""
+    _, serial = _train(steps=4, batch=8)
+
+    cfg = GPT2Config.tiny(dropout=0.0, sequence_parallel_axis="seq",
+                          sequence_parallel_mode="ulysses")
+    model = GPT2LMHeadModel(cfg)
+    engine, _, _, _ = deepspeed.initialize(
+        model=model,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "sequence_parallel": {"enabled": True, "size": 4},
+        })
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(8, 32))
+    uly = []
+    for _ in range(4):
+        loss = engine(ids, ids)
+        engine.backward(loss)
+        engine.step()
+        uly.append(float(loss))
+    np.testing.assert_allclose(uly[0], serial[0], rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(uly, serial, rtol=1e-2, atol=1e-2)
+
+
 def test_sp_composes_with_zero2():
     _, serial = _train(steps=4, batch=8)
     _, sp = _train({"sequence_parallel": {"enabled": True, "size": 4},
