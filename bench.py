@@ -103,6 +103,8 @@ def main_xl():
             "mfu": round(tok * flops_per_token(cfg, seq) / 197e12, 4),
             "note": "host<->device link is a network tunnel in this "
                     "environment; step time is transfer-bound",
+            **({"fallback": os.environ["DS_BENCH_FALLBACK"]}
+               if os.environ.get("DS_BENCH_FALLBACK") else {}),
         },
     }))
 
@@ -175,6 +177,8 @@ def main():
             "devices": jax.device_count(),
             "loss": loss,
             "params": cfg.num_params(),
+            **({"fallback": os.environ["DS_BENCH_FALLBACK"]}
+               if os.environ.get("DS_BENCH_FALLBACK") else {}),
         },
     }))
 
@@ -183,6 +187,7 @@ if __name__ == "__main__":
     if not _device_probe():
         print("bench: falling back to CPU", file=sys.stderr)
         os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["DS_BENCH_FALLBACK"] = "accelerator-init-failed"
         # sitecustomize pins jax_platforms at interpreter startup; the env
         # var alone is not consulted again (see tests/conftest.py).
         import jax

@@ -387,10 +387,6 @@ def ulysses_attention(q, k, v, axis_name, causal=False, mask=None,
             "'{}' axis size ({}); use ring attention for more shards "
             "than heads".format(h, axis_name, n))
 
-    def to_heads(x):     # [B, H, T/n, D] -> [B, H/n, T, D]
-        return jax.lax.all_to_all(x, axis_name, split_axis=1,
-                                  concat_axis=2, tiled=True)
-
     def to_tokens(x):    # [B, H/n, T, D] -> [B, H, T/n, D]
         return jax.lax.all_to_all(x, axis_name, split_axis=2,
                                   concat_axis=1, tiled=True)
@@ -398,7 +394,11 @@ def ulysses_attention(q, k, v, axis_name, causal=False, mask=None,
     full_mask = None
     if mask is not None:
         full_mask = jax.lax.all_gather(mask, axis_name, axis=1, tiled=True)
-    o = flash_attention(to_heads(q), to_heads(k), to_heads(v),
+    # One exchange for all three tensors (q/k/v stacked): the documented
+    # "two all_to_alls per layer" — one in, one out.
+    qkv = jax.lax.all_to_all(jnp.stack([q, k, v]), axis_name,
+                             split_axis=2, concat_axis=3, tiled=True)
+    o = flash_attention(qkv[0], qkv[1], qkv[2],
                         mask=full_mask, causal=causal, scale=scale,
                         block_q=block_q, block_k=block_k)
     return to_tokens(o)
