@@ -4,10 +4,17 @@ and include/exclude filters, plus world-info encode/decode and ds_report).
 
 import base64
 import json
+import os
+import subprocess
+import sys
 
 import pytest
 
 from deepspeed_tpu.launcher import runner as dsrun
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+BIN_DIR = os.path.join(REPO_ROOT, "bin")
 
 
 def test_parse_hostfile(tmp_path):
@@ -177,3 +184,41 @@ def test_elastic_config_entry():
     from deepspeed_tpu.version import version as ds_version
     batch, valid = compute_elastic_config(ds_config, ds_version)
     assert batch > 0 and len(valid) > 0
+
+
+def test_ds_ssh_local_fallback(tmp_path):
+    """bin/ds_ssh without a hostfile executes the command locally
+    (reference bin/ds_ssh falls back the same way)."""
+    script = os.path.join(BIN_DIR, "ds_ssh")
+    r = subprocess.run(
+        [sys.executable, script, "--hostfile", str(tmp_path / "absent"),
+         "echo", "ds-ssh-ok"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0
+    assert "ds-ssh-ok" in r.stdout
+    assert "executing command locally" in r.stderr
+
+
+def test_ds_ssh_hostfile_without_transport(tmp_path):
+    """With a hostfile but neither pdsh nor ssh available, ds_ssh fails
+    loudly instead of tracebacking."""
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("worker-1 slots=4\nworker-2 slots=4\n")
+    script = os.path.join(BIN_DIR, "ds_ssh")
+    env = dict(os.environ, PATH="/nonexistent-path-for-test")
+    r = subprocess.run(
+        [sys.executable, script, "--hostfile", str(hostfile), "true"],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert r.returncode == 1
+    assert "neither pdsh nor ssh" in r.stderr
+    assert "Traceback" not in r.stderr
+
+
+def test_ds_cli_aliases_share_runner():
+    """bin/ds and bin/deepspeed.pt are the launcher CLI (--help exits 0)."""
+    for name in ("ds", "deepspeed.pt"):
+        r = subprocess.run([sys.executable, os.path.join(BIN_DIR, name),
+                            "--help"], capture_output=True, text=True,
+                           timeout=60)
+        assert r.returncode == 0, r.stderr
+        assert "hostfile" in r.stdout
