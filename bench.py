@@ -67,9 +67,17 @@ def main_xl():
     import deepspeed_tpu as deepspeed
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
 
-    cfg = GPT2Config.gpt2_xl(dropout=0.0, remat=True)
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = GPT2Config.gpt2_xl(dropout=0.0, remat=True)
+        batch, seq = 2, 1024
+    else:
+        # CPU (incl. the wedged-relay fallback): 1.5B on host compute
+        # takes hours — exercise the same offload path at smoke size so
+        # the harness still emits its one line.
+        cfg = GPT2Config.tiny(dropout=0.0)
+        batch, seq = 2, 64
     model = GPT2LMHeadModel(cfg)
-    batch, seq = 2, 1024
     engine, _, _, _ = deepspeed.initialize(
         model=model,
         config_params={
@@ -92,17 +100,21 @@ def main_xl():
         times.append(time.time() - t0)
     tok = batch * seq / min(times)
     print(json.dumps({
-        "metric": "gpt2_1.5b_offload_tokens_per_sec_per_chip",
+        "metric": ("gpt2_1.5b_offload_tokens_per_sec_per_chip" if on_tpu
+                   else "gpt2_tiny_offload_smoke_tokens_per_sec"),
         "value": round(tok, 2),
         "unit": "tokens/s/chip",
-        "vs_baseline": 1.0,  # capacity parity: 1.5B trains on one chip
+        # capacity parity: 1.5B trains on one chip (1.0 only when the
+        # real config actually ran)
+        "vs_baseline": 1.0 if on_tpu else 0.0,
         "extra": {
             "params": cfg.num_params(),
             "loss": float(loss),
             "step_seconds": round(min(times), 1),
-            "mfu": round(tok * flops_per_token(cfg, seq) / 197e12, 4),
-            "note": "host<->device link is a network tunnel in this "
-                    "environment; step time is transfer-bound",
+            **({"mfu": round(tok * flops_per_token(cfg, seq) / 197e12, 4),
+                "note": "host<->device link is a network tunnel in this "
+                        "environment; step time is transfer-bound"}
+               if on_tpu else {}),
             **({"fallback": os.environ["DS_BENCH_FALLBACK"]}
                if os.environ.get("DS_BENCH_FALLBACK") else {}),
         },
