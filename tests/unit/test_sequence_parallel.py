@@ -189,6 +189,34 @@ def test_sp_rejects_indivisible_token_dim():
         engine(ids, ids)
 
 
+def test_sp_composes_with_fp16_and_grad_accumulation():
+    """fp16 dynamic loss scaling + gas=2 under SP: the scaler's overflow
+    bookkeeping and the host-side grad accumulation both run OUTSIDE the
+    shard_map program and must compose with it."""
+    cfg = GPT2Config.tiny(dropout=0.0, sequence_parallel_axis="seq")
+    engine, _, _, _ = deepspeed.initialize(
+        model=GPT2LMHeadModel(cfg),
+        config_params={
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "fp16": {"enabled": True, "initial_scale_power": 8},
+            "sequence_parallel": {"enabled": True, "size": 8},
+        })
+    rng = np.random.RandomState(0)
+    losses = []
+    for step in range(6):
+        ids = rng.randint(0, cfg.vocab_size, size=(4, 32))
+        loss = engine(ids, ids)
+        engine.backward(loss)
+        engine.step()
+        if engine.is_gradient_accumulation_boundary():
+            losses.append(float(loss))
+    assert engine.skipped_steps == 0
+    assert losses[-1] < losses[0] + 0.05, losses
+
+
 def test_sp_requires_sequence_shardable_model():
     """A model without sequence_parallel_axis must be rejected loudly —
     sharding a serial model's tokens would train a different function."""
