@@ -59,3 +59,42 @@ def test_allreduce_over_mesh(eight_devices):
     out = shard_map(body, mesh=mesh, in_specs=P("data"),
                     out_specs=P("data"))(jnp.arange(8.0))
     np.testing.assert_allclose(np.asarray(out), np.full((8,), 28.0))
+
+
+def test_build_mesh_four_axes(eight_devices):
+    """('pipe','data','seq','model') mesh construction + size helpers."""
+    import jax
+
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+
+    m = mesh_lib.build_mesh(devices=jax.devices()[:8], num_sp=4, num_dp=2)
+    assert dict(m.shape) == {"pipe": 1, "data": 2, "seq": 4, "model": 1}
+    assert mesh_lib.dp_size(m) == 2
+    assert mesh_lib.sp_size(m) == 4
+    assert mesh_lib.mp_size(m) == 1
+    assert mesh_lib.pp_size(m) == 1
+
+
+def test_batch_partition_spec_policy():
+    """The single batch-sharding heuristic: batch dim over 'data' when
+    divisible, token dim over 'seq' when present and divisible."""
+    import numpy as np
+
+    from deepspeed_tpu.parallel.mesh import batch_partition_spec as spec
+    from jax.sharding import PartitionSpec as P
+
+    x2 = np.zeros((8, 32))
+    x1 = np.zeros((8,))
+    assert spec(x2, dp=2, sp=4) == P("data", "seq")
+    assert spec(x2, dp=2) == P("data")
+    assert spec(x1, dp=2, sp=4) == P("data")
+    assert spec(np.zeros((7, 32)), dp=2, sp=4) == P()   # indivisible batch
+    assert spec(np.zeros((8, 33)), dp=2, sp=4) == P("data")  # token dim odd
+    assert spec(np.float32(1.0), dp=2, sp=4) == P()     # scalar
+
+
+def test_active_sp_axis_outside_shard_map():
+    from deepspeed_tpu.parallel.mesh import active_sp_axis
+
+    assert active_sp_axis(None) is None
+    assert active_sp_axis("seq") is None  # not bound outside shard_map
