@@ -1,9 +1,13 @@
 """DeepSpeedConfig: parse ds_config.json (or dict) into a typed config object.
 
-Behavior-parity port of reference runtime/config.py:515-783 — same key surface,
-batch-triangle completion (any two of train_batch_size /
-train_micro_batch_size_per_gpu / gradient_accumulation_steps imply the third),
-elasticity override, and sanity checks. TPU deltas:
+Honors the reference's ds_config.json contract (reference
+runtime/config.py:515-783) — same key surface, batch-triangle completion
+(any two of train_batch_size / train_micro_batch_size_per_gpu /
+gradient_accumulation_steps imply the third), elasticity override, and
+sanity checks — but the scalar surface here is DECLARATIVE: every plain
+config attribute is one row in ``_SCHEMA`` (attr, JSON path, default,
+optional gate/transform), applied by a single reader. Adding a key is one
+table row, not a new getter function. TPU deltas:
 
 - world size comes from the mesh/data-parallel size (``jax.device_count()``
   by default) instead of torch.distributed;
@@ -57,431 +61,190 @@ DEEPSPEED_OPTIMIZERS = [
 ]
 
 
-def get_amp_enabled(param_dict):
-    if AMP in param_dict.keys():
-        return get_scalar_param(param_dict[AMP], AMP_ENABLED, AMP_ENABLED_DEFAULT)
-    return False
+def _read(param_dict, path, default):
+    """Scalar at ``path`` (a key tuple descending into sub-dicts), or
+    ``default`` when any level is absent. A level that is PRESENT but not
+    an object is a config error and raises — silently defaulting would
+    turn a typo like ``"fp16": true`` into training without loss
+    scaling."""
+    node = param_dict
+    for key in path[:-1]:
+        node = node.get(key)
+        if node is None:
+            return default
+        if not isinstance(node, dict):
+            raise TypeError(
+                "DeepSpeedConfig: expected '{}' to be a JSON object, got "
+                "{!r}".format(key, node))
+    return get_scalar_param(node, path[-1], default)
 
 
-def get_amp_params(param_dict):
-    if AMP in param_dict.keys():
-        amp_params = dict(param_dict[AMP])
-        amp_params.pop(AMP_ENABLED, None)
-        return amp_params
-    return False
+# ---------------------------------------------------------------------------
+# Declarative scalar schema: attr -> (path, default[, gate]).
+#
+# ``path`` descends into optional sub-blocks; an absent block yields the
+# default. ``gate`` names a previously-assigned attr that must be truthy
+# for the key to be read at all (e.g. the reference only honors
+# fp16.loss_scale when fp16.enabled — a disabled block's values must not
+# leak through). Rows are applied in order, so gates may reference any
+# attr above them.
+# ---------------------------------------------------------------------------
+_SCHEMA = (
+    ("train_batch_size", (TRAIN_BATCH_SIZE,), TRAIN_BATCH_SIZE_DEFAULT),
+    ("train_micro_batch_size_per_gpu", (TRAIN_MICRO_BATCH_SIZE_PER_GPU,),
+     TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT),
+    ("gradient_accumulation_steps", (GRADIENT_ACCUMULATION_STEPS,),
+     GRADIENT_ACCUMULATION_STEPS_DEFAULT),
+    ("steps_per_print", (STEPS_PER_PRINT,), STEPS_PER_PRINT_DEFAULT),
+    ("dump_state", (DUMP_STATE,), DUMP_STATE_DEFAULT),
+    ("disable_allgather", (DISABLE_ALLGATHER,), DISABLE_ALLGATHER_DEFAULT),
+    ("allreduce_always_fp32", (FP32_ALLREDUCE,), FP32_ALLREDUCE_DEFAULT),
+    ("prescale_gradients", (PRESCALE_GRADIENTS,),
+     PRESCALE_GRADIENTS_DEFAULT),
+    ("gradient_predivide_factor", (GRADIENT_PREDIVIDE_FACTOR,),
+     GRADIENT_PREDIVIDE_FACTOR_DEFAULT),
+    ("sparse_gradients_enabled", (SPARSE_GRADIENTS,),
+     SPARSE_GRADIENTS_DEFAULT),
+    ("gradient_clipping", (GRADIENT_CLIPPING,), GRADIENT_CLIPPING_DEFAULT),
+    ("zero_allow_untested_optimizer", (ZERO_ALLOW_UNTESTED_OPTIMIZER,),
+     ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT),
+    ("wall_clock_breakdown", (WALL_CLOCK_BREAKDOWN,),
+     WALL_CLOCK_BREAKDOWN_DEFAULT),
+    ("memory_breakdown", (MEMORY_BREAKDOWN,), MEMORY_BREAKDOWN_DEFAULT),
+    ("sequence_parallel_enabled", (SEQUENCE_PARALLEL,
+     SEQUENCE_PARALLEL_ENABLED), SEQUENCE_PARALLEL_ENABLED_DEFAULT),
+    ("sequence_parallel_size", (SEQUENCE_PARALLEL, SEQUENCE_PARALLEL_SIZE),
+     SEQUENCE_PARALLEL_SIZE_DEFAULT),
+    ("fp16_enabled", (FP16, FP16_ENABLED), FP16_ENABLED_DEFAULT),
+    ("bfloat16_enabled", (BFLOAT16, BFLOAT16_ENABLED),
+     BFLOAT16_ENABLED_DEFAULT),
+    ("amp_enabled", (AMP, AMP_ENABLED), AMP_ENABLED_DEFAULT),
+    ("loss_scale", (FP16, FP16_LOSS_SCALE), FP16_LOSS_SCALE_DEFAULT,
+     "fp16_enabled"),
+    ("optimizer_legacy_fusion", (OPTIMIZER, LEGACY_FUSION),
+     LEGACY_FUSION_DEFAULT),
+    ("tensorboard_enabled", (TENSORBOARD, TENSORBOARD_ENABLED),
+     TENSORBOARD_ENABLED_DEFAULT),
+    ("tensorboard_output_path", (TENSORBOARD, TENSORBOARD_OUTPUT_PATH),
+     TENSORBOARD_OUTPUT_PATH_DEFAULT, "tensorboard_enabled"),
+    ("tensorboard_job_name", (TENSORBOARD, TENSORBOARD_JOB_NAME),
+     TENSORBOARD_JOB_NAME_DEFAULT, "tensorboard_enabled"),
+    ("pld_enabled", (PROGRESSIVE_LAYER_DROP, PLD_ENABLED),
+     PLD_ENABLED_DEFAULT),
+)
 
+# fp16 sub-keys that, when any is present, switch the loss scaler from
+# static to dynamic; collected into the scaler's constructor-arg dict.
+_DYNAMIC_SCALE_ARGS = (
+    ("INITIAL_LOSS_SCALE", FP16_INITIAL_SCALE_POWER,
+     FP16_INITIAL_SCALE_POWER_DEFAULT),
+    ("SCALE_WINDOW", FP16_LOSS_SCALE_WINDOW, FP16_LOSS_SCALE_WINDOW_DEFAULT),
+    ("DELAYED_SHIFT", FP16_HYSTERESIS, FP16_HYSTERESIS_DEFAULT),
+    ("MIN_LOSS_SCALE", FP16_MIN_LOSS_SCALE, FP16_MIN_LOSS_SCALE_DEFAULT),
+)
 
-def get_fp16_enabled(param_dict):
-    if FP16 in param_dict.keys():
-        return get_scalar_param(param_dict[FP16], FP16_ENABLED, FP16_ENABLED_DEFAULT)
-    return False
+# Sparse-attention blocks: per sparsity mode, the keys that mode accepts.
+# The parsed dict is {mode, **{key: value-or-default}} (reference
+# config.py:118-178 spells each of these out as its own function).
+_SPARSE_MODE_KEYS = {
+    SPARSE_DENSE_MODE: (SPARSE_BLOCK,),
+    SPARSE_FIXED_MODE: (
+        SPARSE_BLOCK, SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+        SPARSE_NUM_LOCAL_BLOCKS, SPARSE_NUM_GLOBAL_BLOCKS,
+        SPARSE_ATTENTION_TYPE, SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
+        SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS),
+    SPARSE_VARIABLE_MODE: (
+        SPARSE_BLOCK, SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+        SPARSE_NUM_RANDOM_BLOCKS, SPARSE_LOCAL_WINDOW_BLOCKS,
+        SPARSE_GLOBAL_BLOCK_INDICES, SPARSE_GLOBAL_BLOCK_END_INDICES,
+        SPARSE_ATTENTION_TYPE, SPARSE_HORIZONTAL_GLOBAL_ATTENTION),
+    SPARSE_BIGBIRD_MODE: (
+        SPARSE_BLOCK, SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+        SPARSE_NUM_RANDOM_BLOCKS, SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+        SPARSE_NUM_GLOBAL_BLOCKS),
+    SPARSE_BSLONGFORMER_MODE: (
+        SPARSE_BLOCK, SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+        SPARSE_NUM_SLIDING_WINDOW_BLOCKS, SPARSE_GLOBAL_BLOCK_INDICES,
+        SPARSE_GLOBAL_BLOCK_END_INDICES),
+}
 
+# Defaults for every sparse key, keyed by the key constant itself.
+_SPARSE_KEY_DEFAULTS = {
+    SPARSE_BLOCK: SPARSE_BLOCK_DEFAULT,
+    SPARSE_DIFFERENT_LAYOUT_PER_HEAD: SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT,
+    SPARSE_NUM_LOCAL_BLOCKS: SPARSE_NUM_LOCAL_BLOCKS_DEFAULT,
+    SPARSE_NUM_GLOBAL_BLOCKS: SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT,
+    SPARSE_ATTENTION_TYPE: SPARSE_ATTENTION_TYPE_DEFAULT,
+    SPARSE_HORIZONTAL_GLOBAL_ATTENTION:
+        SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT,
+    SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS:
+        SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS_DEFAULT,
+    SPARSE_NUM_RANDOM_BLOCKS: SPARSE_NUM_RANDOM_BLOCKS_DEFAULT,
+    SPARSE_LOCAL_WINDOW_BLOCKS: SPARSE_LOCAL_WINDOW_BLOCKS_DEFAULT,
+    SPARSE_GLOBAL_BLOCK_INDICES: SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT,
+    SPARSE_GLOBAL_BLOCK_END_INDICES: SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT,
+    SPARSE_NUM_SLIDING_WINDOW_BLOCKS:
+        SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT,
+}
 
-def get_bfloat16_enabled(param_dict):
-    if BFLOAT16 in param_dict.keys():
-        return get_scalar_param(param_dict[BFLOAT16],
-                                BFLOAT16_ENABLED,
-                                BFLOAT16_ENABLED_DEFAULT)
-    return False
-
-
-def get_loss_scale(param_dict):
-    if get_fp16_enabled(param_dict):
-        return get_scalar_param(param_dict[FP16],
-                                FP16_LOSS_SCALE,
-                                FP16_LOSS_SCALE_DEFAULT)
-    return FP16_LOSS_SCALE_DEFAULT
-
-
-def get_initial_dynamic_scale(param_dict):
-    if get_fp16_enabled(param_dict):
-        initial_scale_power = get_scalar_param(param_dict[FP16],
-                                               FP16_INITIAL_SCALE_POWER,
-                                               FP16_INITIAL_SCALE_POWER_DEFAULT)
-    else:
-        initial_scale_power = FP16_INITIAL_SCALE_POWER_DEFAULT
-    return 2 ** initial_scale_power
-
-
-def get_dynamic_loss_scale_args(param_dict):
-    loss_scale_args = None
-    if get_fp16_enabled(param_dict):
-        fp16_dict = param_dict[FP16]
-        dynamic_props = [
-            FP16_INITIAL_SCALE_POWER,
-            FP16_LOSS_SCALE_WINDOW,
-            FP16_MIN_LOSS_SCALE,
-            FP16_HYSTERESIS,
-        ]
-        if any(prop in fp16_dict for prop in dynamic_props):
-            init_scale = get_scalar_param(fp16_dict,
-                                          FP16_INITIAL_SCALE_POWER,
-                                          FP16_INITIAL_SCALE_POWER_DEFAULT)
-            scale_window = get_scalar_param(fp16_dict,
-                                            FP16_LOSS_SCALE_WINDOW,
-                                            FP16_LOSS_SCALE_WINDOW_DEFAULT)
-            delayed_shift = get_scalar_param(fp16_dict,
-                                             FP16_HYSTERESIS,
-                                             FP16_HYSTERESIS_DEFAULT)
-            min_loss_scale = get_scalar_param(fp16_dict,
-                                              FP16_MIN_LOSS_SCALE,
-                                              FP16_MIN_LOSS_SCALE_DEFAULT)
-            loss_scale_args = {
-                "INITIAL_LOSS_SCALE": 2 ** init_scale,
-                "SCALE_WINDOW": scale_window,
-                "DELAYED_SHIFT": delayed_shift,
-                "MIN_LOSS_SCALE": min_loss_scale,
-            }
-    return loss_scale_args
-
-
-def get_gradient_accumulation_steps(param_dict):
-    return get_scalar_param(param_dict,
-                            GRADIENT_ACCUMULATION_STEPS,
-                            GRADIENT_ACCUMULATION_STEPS_DEFAULT)
-
-
-def get_sparse_gradients_enabled(param_dict):
-    return get_scalar_param(param_dict, SPARSE_GRADIENTS, SPARSE_GRADIENTS_DEFAULT)
+# The pipeline engine block and its defaults (reference config.py:363-375).
+_PIPELINE_DEFAULTS = {
+    "stages": "auto",
+    "partition": "best",
+    "seed_layers": False,
+    "activation_checkpoint_interval": 0,
+}
 
 
 def get_sequence_parallel_enabled(param_dict):
-    sub = param_dict.get(SEQUENCE_PARALLEL, {})
-    return get_scalar_param(sub, SEQUENCE_PARALLEL_ENABLED,
-                            SEQUENCE_PARALLEL_ENABLED_DEFAULT)
+    """Public: the engine peeks at this before the full config parse."""
+    return _read(param_dict, (SEQUENCE_PARALLEL, SEQUENCE_PARALLEL_ENABLED),
+                 SEQUENCE_PARALLEL_ENABLED_DEFAULT)
 
 
 def get_sequence_parallel_size(param_dict):
-    sub = param_dict.get(SEQUENCE_PARALLEL, {})
-    return get_scalar_param(sub, SEQUENCE_PARALLEL_SIZE,
-                            SEQUENCE_PARALLEL_SIZE_DEFAULT)
-
-
-def get_zero_allow_untested_optimizer(param_dict):
-    return get_scalar_param(param_dict,
-                            ZERO_ALLOW_UNTESTED_OPTIMIZER,
-                            ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
-
-
-def get_gradient_clipping(param_dict):
-    return get_scalar_param(param_dict, GRADIENT_CLIPPING, GRADIENT_CLIPPING_DEFAULT)
-
-
-def get_sparse_attention(param_dict):
-    if SPARSE_ATTENTION in param_dict.keys():
-        sparsity = param_dict[SPARSE_ATTENTION]
-        mode = get_sparse_attention_mode(sparsity)
-        if mode == SPARSE_DENSE_MODE:
-            return get_sparse_dense_config(sparsity)
-        elif mode == SPARSE_FIXED_MODE:
-            return get_sparse_fixed_config(sparsity)
-        elif mode == SPARSE_VARIABLE_MODE:
-            return get_sparse_variable_config(sparsity)
-        elif mode == SPARSE_BIGBIRD_MODE:
-            return get_sparse_bigbird_config(sparsity)
-        elif mode == SPARSE_BSLONGFORMER_MODE:
-            return get_sparse_bslongformer_config(sparsity)
-        else:
-            raise NotImplementedError(
-                "Given sparsity mode, {}, has not been implemented yet!".format(mode))
-    return None
-
-
-def get_sparse_dense_config(sparsity):
-    block = get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT)
-    return {SPARSE_MODE: SPARSE_DENSE_MODE, SPARSE_BLOCK: block}
-
-
-def get_sparse_fixed_config(sparsity):
-    block = get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT)
-    different_layout_per_head = get_scalar_param(
-        sparsity,
-        SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
-        SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT)
-    num_local_blocks = get_scalar_param(sparsity,
-                                        SPARSE_NUM_LOCAL_BLOCKS,
-                                        SPARSE_NUM_LOCAL_BLOCKS_DEFAULT)
-    num_global_blocks = get_scalar_param(sparsity,
-                                         SPARSE_NUM_GLOBAL_BLOCKS,
-                                         SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT)
-    attention = get_scalar_param(sparsity,
-                                 SPARSE_ATTENTION_TYPE,
-                                 SPARSE_ATTENTION_TYPE_DEFAULT)
-    horizontal_global_attention = get_scalar_param(
-        sparsity,
-        SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
-        SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT)
-    num_different_global_patterns = get_scalar_param(
-        sparsity,
-        SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS,
-        SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS_DEFAULT)
-    return {
-        SPARSE_MODE: SPARSE_FIXED_MODE,
-        SPARSE_BLOCK: block,
-        SPARSE_DIFFERENT_LAYOUT_PER_HEAD: different_layout_per_head,
-        SPARSE_NUM_LOCAL_BLOCKS: num_local_blocks,
-        SPARSE_NUM_GLOBAL_BLOCKS: num_global_blocks,
-        SPARSE_ATTENTION_TYPE: attention,
-        SPARSE_HORIZONTAL_GLOBAL_ATTENTION: horizontal_global_attention,
-        SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS: num_different_global_patterns,
-    }
-
-
-def get_sparse_variable_config(sparsity):
-    block = get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT)
-    different_layout_per_head = get_scalar_param(
-        sparsity,
-        SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
-        SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT)
-    num_random_blocks = get_scalar_param(sparsity,
-                                         SPARSE_NUM_RANDOM_BLOCKS,
-                                         SPARSE_NUM_RANDOM_BLOCKS_DEFAULT)
-    local_window_blocks = get_scalar_param(sparsity,
-                                           SPARSE_LOCAL_WINDOW_BLOCKS,
-                                           SPARSE_LOCAL_WINDOW_BLOCKS_DEFAULT)
-    global_block_indices = get_scalar_param(sparsity,
-                                            SPARSE_GLOBAL_BLOCK_INDICES,
-                                            SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT)
-    global_block_end_indices = get_scalar_param(
-        sparsity,
-        SPARSE_GLOBAL_BLOCK_END_INDICES,
-        SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT)
-    attention = get_scalar_param(sparsity,
-                                 SPARSE_ATTENTION_TYPE,
-                                 SPARSE_ATTENTION_TYPE_DEFAULT)
-    horizontal_global_attention = get_scalar_param(
-        sparsity,
-        SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
-        SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT)
-    return {
-        SPARSE_MODE: SPARSE_VARIABLE_MODE,
-        SPARSE_BLOCK: block,
-        SPARSE_DIFFERENT_LAYOUT_PER_HEAD: different_layout_per_head,
-        SPARSE_NUM_RANDOM_BLOCKS: num_random_blocks,
-        SPARSE_LOCAL_WINDOW_BLOCKS: local_window_blocks,
-        SPARSE_GLOBAL_BLOCK_INDICES: global_block_indices,
-        SPARSE_GLOBAL_BLOCK_END_INDICES: global_block_end_indices,
-        SPARSE_ATTENTION_TYPE: attention,
-        SPARSE_HORIZONTAL_GLOBAL_ATTENTION: horizontal_global_attention,
-    }
-
-
-def get_sparse_bigbird_config(sparsity):
-    block = get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT)
-    different_layout_per_head = get_scalar_param(
-        sparsity,
-        SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
-        SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT)
-    num_random_blocks = get_scalar_param(sparsity,
-                                         SPARSE_NUM_RANDOM_BLOCKS,
-                                         SPARSE_NUM_RANDOM_BLOCKS_DEFAULT)
-    num_sliding_window_blocks = get_scalar_param(
-        sparsity,
-        SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
-        SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT)
-    num_global_blocks = get_scalar_param(sparsity,
-                                         SPARSE_NUM_GLOBAL_BLOCKS,
-                                         SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT)
-    return {
-        SPARSE_MODE: SPARSE_BIGBIRD_MODE,
-        SPARSE_BLOCK: block,
-        SPARSE_DIFFERENT_LAYOUT_PER_HEAD: different_layout_per_head,
-        SPARSE_NUM_RANDOM_BLOCKS: num_random_blocks,
-        SPARSE_NUM_SLIDING_WINDOW_BLOCKS: num_sliding_window_blocks,
-        SPARSE_NUM_GLOBAL_BLOCKS: num_global_blocks,
-    }
-
-
-def get_sparse_bslongformer_config(sparsity):
-    block = get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT)
-    different_layout_per_head = get_scalar_param(
-        sparsity,
-        SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
-        SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT)
-    num_sliding_window_blocks = get_scalar_param(
-        sparsity,
-        SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
-        SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT)
-    global_block_indices = get_scalar_param(sparsity,
-                                            SPARSE_GLOBAL_BLOCK_INDICES,
-                                            SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT)
-    global_block_end_indices = get_scalar_param(
-        sparsity,
-        SPARSE_GLOBAL_BLOCK_END_INDICES,
-        SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT)
-    return {
-        SPARSE_MODE: SPARSE_BSLONGFORMER_MODE,
-        SPARSE_BLOCK: block,
-        SPARSE_DIFFERENT_LAYOUT_PER_HEAD: different_layout_per_head,
-        SPARSE_NUM_SLIDING_WINDOW_BLOCKS: num_sliding_window_blocks,
-        SPARSE_GLOBAL_BLOCK_INDICES: global_block_indices,
-        SPARSE_GLOBAL_BLOCK_END_INDICES: global_block_end_indices,
-    }
-
-
-def get_sparse_attention_mode(param_dict):
-    return get_scalar_param(param_dict, SPARSE_MODE, SPARSE_MODE_DEFAULT)
-
-
-def get_sparse_attention_type(param_dict):
-    return get_scalar_param(param_dict,
-                            SPARSE_ATTENTION_TYPE,
-                            SPARSE_ATTENTION_TYPE_DEFAULT)
-
-
-def get_pipeline_config(param_dict):
-    """Parse the pipeline engine block (reference config.py:363-375)."""
-    default_pipeline = {
-        "stages": "auto",
-        "partition": "best",
-        "seed_layers": False,
-        "activation_checkpoint_interval": 0,
-    }
-    config = default_pipeline
-    for key, val in param_dict.get("pipeline", {}).items():
-        config[key] = val
-    return config
-
-
-def get_optimizer_name(param_dict):
-    if OPTIMIZER in param_dict.keys() and TYPE in param_dict[OPTIMIZER].keys():
-        return param_dict[OPTIMIZER][TYPE]
-    return OPTIMIZER_TYPE_DEFAULT
-
-
-def get_optimizer_params(param_dict):
-    if get_optimizer_name(param_dict) is not None and \
-            OPTIMIZER_PARAMS in param_dict[OPTIMIZER].keys():
-        return param_dict[OPTIMIZER][OPTIMIZER_PARAMS]
-    return None
-
-
-def get_optimizer_gradient_clipping(param_dict):
-    optimizer_params = get_optimizer_params(param_dict)
-    if optimizer_params is not None and MAX_GRAD_NORM in optimizer_params.keys():
-        return optimizer_params[MAX_GRAD_NORM]
-    return None
-
-
-def get_optimizer_legacy_fusion(param_dict):
-    if OPTIMIZER in param_dict.keys() and LEGACY_FUSION in param_dict[OPTIMIZER].keys():
-        return param_dict[OPTIMIZER][LEGACY_FUSION]
-    return LEGACY_FUSION_DEFAULT
-
-
-def get_scheduler_name(param_dict):
-    if SCHEDULER in param_dict.keys() and TYPE in param_dict[SCHEDULER].keys():
-        return param_dict[SCHEDULER][TYPE]
-    return SCHEDULER_TYPE_DEFAULT
-
-
-def get_scheduler_params(param_dict):
-    if get_scheduler_name(param_dict) is not None and \
-            SCHEDULER_PARAMS in param_dict[SCHEDULER].keys():
-        return param_dict[SCHEDULER][SCHEDULER_PARAMS]
-    return None
-
-
-def get_train_batch_size(param_dict):
-    return get_scalar_param(param_dict, TRAIN_BATCH_SIZE, TRAIN_BATCH_SIZE_DEFAULT)
-
-
-def get_train_micro_batch_size_per_gpu(param_dict):
-    return get_scalar_param(param_dict,
-                            TRAIN_MICRO_BATCH_SIZE_PER_GPU,
-                            TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
-
-
-def get_wall_clock_breakdown(param_dict):
-    return get_scalar_param(param_dict,
-                            WALL_CLOCK_BREAKDOWN,
-                            WALL_CLOCK_BREAKDOWN_DEFAULT)
-
-
-def get_memory_breakdown(param_dict):
-    return get_scalar_param(param_dict, MEMORY_BREAKDOWN, MEMORY_BREAKDOWN_DEFAULT)
-
-
-def get_tensorboard_enabled(param_dict):
-    if TENSORBOARD in param_dict.keys():
-        return get_scalar_param(param_dict[TENSORBOARD],
-                                TENSORBOARD_ENABLED,
-                                TENSORBOARD_ENABLED_DEFAULT)
-    return False
-
-
-def get_tensorboard_output_path(param_dict):
-    if get_tensorboard_enabled(param_dict):
-        return get_scalar_param(param_dict[TENSORBOARD],
-                                TENSORBOARD_OUTPUT_PATH,
-                                TENSORBOARD_OUTPUT_PATH_DEFAULT)
-    return TENSORBOARD_OUTPUT_PATH_DEFAULT
-
-
-def get_tensorboard_job_name(param_dict):
-    if get_tensorboard_enabled(param_dict):
-        return get_scalar_param(param_dict[TENSORBOARD],
-                                TENSORBOARD_JOB_NAME,
-                                TENSORBOARD_JOB_NAME_DEFAULT)
-    return TENSORBOARD_JOB_NAME_DEFAULT
-
-
-def get_steps_per_print(param_dict):
-    return get_scalar_param(param_dict, STEPS_PER_PRINT, STEPS_PER_PRINT_DEFAULT)
-
-
-def get_disable_allgather(param_dict):
-    return get_scalar_param(param_dict, DISABLE_ALLGATHER, DISABLE_ALLGATHER_DEFAULT)
-
-
-def get_dump_state(param_dict):
-    return get_scalar_param(param_dict, DUMP_STATE, DUMP_STATE_DEFAULT)
-
-
-def get_gradient_predivide_factor(param_dict):
-    return get_scalar_param(param_dict,
-                            GRADIENT_PREDIVIDE_FACTOR,
-                            GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
-
-
-def get_allreduce_always_fp32(param_dict):
-    return get_scalar_param(param_dict, FP32_ALLREDUCE, FP32_ALLREDUCE_DEFAULT)
-
-
-def get_prescale_gradients(param_dict):
-    return get_scalar_param(param_dict, PRESCALE_GRADIENTS, PRESCALE_GRADIENTS_DEFAULT)
-
-
-def get_pld_enabled(param_dict):
-    if PROGRESSIVE_LAYER_DROP in param_dict.keys():
-        return get_scalar_param(param_dict[PROGRESSIVE_LAYER_DROP],
-                                PLD_ENABLED,
-                                PLD_ENABLED_DEFAULT)
-    return False
-
-
-def get_pld_params(param_dict):
-    if get_pld_enabled(param_dict):
-        pld_params = dict(param_dict[PROGRESSIVE_LAYER_DROP])
-        pld_params.pop(PLD_ENABLED, None)
-        return pld_params
-    return False
-
-
-def get_checkpoint_params(param_dict):
-    return param_dict.get(CHECKPOINT, {})
-
-
-def get_checkpoint_tag_validation_mode(checkpoint_params):
-    tag_validation_mode = checkpoint_params.get(CHECKPOINT_TAG_VALIDATION,
-                                                CHECKPOINT_TAG_VALIDATION_DEFAULT)
-    tag_validation_mode = tag_validation_mode.upper()
-    if tag_validation_mode in CHECKPOINT_TAG_VALIDATION_MODES:
-        return tag_validation_mode
-    raise ValueError(
-        "Checkpoint config contains invalid tag_validation "
-        "value of {}, expecting one of {}".format(tag_validation_mode,
-                                                  CHECKPOINT_TAG_VALIDATION_MODES))
+    """Public: the engine peeks at this before the full config parse."""
+    return _read(param_dict, (SEQUENCE_PARALLEL, SEQUENCE_PARALLEL_SIZE),
+                 SEQUENCE_PARALLEL_SIZE_DEFAULT)
+
+
+def parse_sparse_attention(param_dict):
+    """``sparse_attention`` block -> flat {mode, **fields} dict, or None."""
+    if SPARSE_ATTENTION not in param_dict:
+        return None
+    sparsity = param_dict[SPARSE_ATTENTION]
+    mode = get_scalar_param(sparsity, SPARSE_MODE, SPARSE_MODE_DEFAULT)
+    if mode not in _SPARSE_MODE_KEYS:
+        raise NotImplementedError(
+            "Given sparsity mode, {}, has not been implemented yet!".format(
+                mode))
+    parsed = {SPARSE_MODE: mode}
+    for key in _SPARSE_MODE_KEYS[mode]:
+        parsed[key] = get_scalar_param(sparsity, key,
+                                       _SPARSE_KEY_DEFAULTS[key])
+    return parsed
+
+
+def _typed_block(param_dict, section, exclude):
+    """A copy of ``param_dict[section]`` minus ``exclude`` — the shape the
+    engine passes through to amp/PLD constructors. Returns False when the
+    block is absent (reference quirk: callers truth-test it)."""
+    if section not in param_dict:
+        return False
+    block = dict(param_dict[section])
+    block.pop(exclude, None)
+    return block
+
+
+def _named_block(param_dict, section, default_name, params_key):
+    """(name, params) from an {"type": ..., "params": {...}} block, as used
+    by both the optimizer and scheduler entries."""
+    block = param_dict.get(section)
+    name = block.get(TYPE, default_name) if isinstance(block, dict) \
+        else default_name
+    params = block.get(params_key) if name is not None and \
+        isinstance(block, dict) else None
+    return name, params
 
 
 def _default_world_size(mpu=None):
@@ -515,120 +278,122 @@ class DeepSpeedConfig(object):
             self._param_dict = param_dict
 
         self.global_rank = _default_global_rank()
-        self.world_size = world_size if world_size is not None else _default_world_size(mpu)
+        self.world_size = world_size if world_size is not None \
+            else _default_world_size(mpu)
 
-        # If elastic-mode enabled, compute batch params and update _param_dict
-        # (reference config.py:538-589).
-        self.elasticity_enabled = elasticity_enabled(self._param_dict)
-        if self.elasticity_enabled:
-            logger.info("DeepSpeed elasticity support enabled")
-            final_batch_size, valid_gpus, micro_batch_size = compute_elastic_config(
-                ds_config=self._param_dict,
-                target_deepspeed_version=__version__,
-                world_size=self.world_size)
-
-            elastic_dict = self._param_dict[ELASTICITY]
-            ensure_immutable_elastic_config(runtime_elastic_config_dict=elastic_dict)
-
-            ignore_non_elastic_batch_info = elastic_dict.get(
-                IGNORE_NON_ELASTIC_BATCH_INFO,
-                IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
-
-            if not ignore_non_elastic_batch_info:
-                batch_params = [
-                    TRAIN_BATCH_SIZE,
-                    TRAIN_MICRO_BATCH_SIZE_PER_GPU,
-                    GRADIENT_ACCUMULATION_STEPS,
-                ]
-                if any(t in self._param_dict for t in batch_params):
-                    raise ElasticityConfigError(
-                        "One or more batch related parameters were found in your "
-                        "ds_config ({}, {}, and/or {}). These parameters *will "
-                        "not be used* since elastic training is enabled, which "
-                        "takes control of these parameters. If you want to "
-                        "suppress this error (the parameters will be silently "
-                        "ignored) please set {}':true in your elasticity "
-                        "config.".format(TRAIN_BATCH_SIZE,
-                                         TRAIN_MICRO_BATCH_SIZE_PER_GPU,
-                                         GRADIENT_ACCUMULATION_STEPS,
-                                         IGNORE_NON_ELASTIC_BATCH_INFO))
-
-            gradient_accu_steps = final_batch_size // (micro_batch_size *
-                                                       self.world_size)
-            logger.info("[Elasticity] valid chip counts: {}".format(valid_gpus))
-
-            self._param_dict[TRAIN_BATCH_SIZE] = final_batch_size
-            self._param_dict[TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch_size
-            self._param_dict[GRADIENT_ACCUMULATION_STEPS] = gradient_accu_steps
+        if elasticity_enabled(self._param_dict):
+            self.elasticity_enabled = True
+            self._apply_elasticity()
+        else:
+            self.elasticity_enabled = False
 
         self._initialize_params(self._param_dict)
         self._configure_train_batch_size()
         self._do_sanity_check()
 
-    def _initialize_params(self, param_dict):
-        self.train_batch_size = get_train_batch_size(param_dict)
-        self.train_micro_batch_size_per_gpu = get_train_micro_batch_size_per_gpu(
-            param_dict)
-        self.gradient_accumulation_steps = get_gradient_accumulation_steps(param_dict)
-        self.steps_per_print = get_steps_per_print(param_dict)
-        self.dump_state = get_dump_state(param_dict)
+    def _apply_elasticity(self):
+        """Overwrite the batch triangle with the elastic schedule
+        (reference config.py:538-589)."""
+        logger.info("DeepSpeed elasticity support enabled")
+        final_batch_size, valid_gpus, micro_batch_size = \
+            compute_elastic_config(
+                ds_config=self._param_dict,
+                target_deepspeed_version=__version__,
+                world_size=self.world_size)
 
-        self.disable_allgather = get_disable_allgather(param_dict)
-        self.allreduce_always_fp32 = get_allreduce_always_fp32(param_dict)
-        self.prescale_gradients = get_prescale_gradients(param_dict)
-        self.gradient_predivide_factor = get_gradient_predivide_factor(param_dict)
-        self.sparse_gradients_enabled = get_sparse_gradients_enabled(param_dict)
-        self.sequence_parallel_enabled = get_sequence_parallel_enabled(param_dict)
-        self.sequence_parallel_size = get_sequence_parallel_size(param_dict)
+        elastic_dict = self._param_dict[ELASTICITY]
+        ensure_immutable_elastic_config(
+            runtime_elastic_config_dict=elastic_dict)
+
+        if not elastic_dict.get(IGNORE_NON_ELASTIC_BATCH_INFO,
+                                IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT):
+            batch_params = [
+                TRAIN_BATCH_SIZE,
+                TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                GRADIENT_ACCUMULATION_STEPS,
+            ]
+            if any(t in self._param_dict for t in batch_params):
+                raise ElasticityConfigError(
+                    "One or more batch related parameters were found in your "
+                    "ds_config ({}, {}, and/or {}). These parameters *will "
+                    "not be used* since elastic training is enabled, which "
+                    "takes control of these parameters. If you want to "
+                    "suppress this error (the parameters will be silently "
+                    "ignored) please set {}':true in your elasticity "
+                    "config.".format(TRAIN_BATCH_SIZE,
+                                     TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                                     GRADIENT_ACCUMULATION_STEPS,
+                                     IGNORE_NON_ELASTIC_BATCH_INFO))
+
+        gradient_accu_steps = final_batch_size // (micro_batch_size *
+                                                   self.world_size)
+        logger.info("[Elasticity] valid chip counts: {}".format(valid_gpus))
+
+        self._param_dict[TRAIN_BATCH_SIZE] = final_batch_size
+        self._param_dict[TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch_size
+        self._param_dict[GRADIENT_ACCUMULATION_STEPS] = gradient_accu_steps
+
+    def _initialize_params(self, param_dict):
+        # The whole plain-scalar surface comes off the schema table; only
+        # structured/derived fields get bespoke code below.
+        for row in _SCHEMA:
+            attr, path, default = row[0], row[1], row[2]
+            gate = row[3] if len(row) > 3 else None
+            if gate is not None and not getattr(self, gate):
+                setattr(self, attr, default)
+            else:
+                setattr(self, attr, _read(param_dict, path, default))
+
+        # fp16 loss scaling: a power-of-two initial scale, plus dynamic-
+        # scaler args iff any dynamic key is present in the fp16 block.
+        power = _read(param_dict, (FP16, FP16_INITIAL_SCALE_POWER),
+                      FP16_INITIAL_SCALE_POWER_DEFAULT) \
+            if self.fp16_enabled else FP16_INITIAL_SCALE_POWER_DEFAULT
+        self.initial_dynamic_scale = 2 ** power
+        self.dynamic_loss_scale_args = None
+        if self.fp16_enabled:
+            fp16_block = param_dict[FP16]
+            if any(key in fp16_block for _, key, _ in _DYNAMIC_SCALE_ARGS):
+                args = {arg: get_scalar_param(fp16_block, key, default)
+                        for arg, key, default in _DYNAMIC_SCALE_ARGS}
+                args["INITIAL_LOSS_SCALE"] = 2 ** args["INITIAL_LOSS_SCALE"]
+                self.dynamic_loss_scale_args = args
+
+        self.amp_params = _typed_block(param_dict, AMP, AMP_ENABLED)
+        self.pld_params = _typed_block(param_dict, PROGRESSIVE_LAYER_DROP,
+                                       PLD_ENABLED) \
+            if self.pld_enabled else False
+
+        self.optimizer_name, self.optimizer_params = _named_block(
+            param_dict, OPTIMIZER, OPTIMIZER_TYPE_DEFAULT, OPTIMIZER_PARAMS)
+        if self.optimizer_name is not None and \
+                self.optimizer_name.lower() in DEEPSPEED_OPTIMIZERS:
+            self.optimizer_name = self.optimizer_name.lower()
+        self.scheduler_name, self.scheduler_params = _named_block(
+            param_dict, SCHEDULER, SCHEDULER_TYPE_DEFAULT, SCHEDULER_PARAMS)
 
         self.zero_config = DeepSpeedZeroConfig(param_dict)
         self.zero_optimization_stage = self.zero_config.stage
         self.zero_enabled = self.zero_optimization_stage > 0
-
         self.activation_checkpointing_config = \
             DeepSpeedActivationCheckpointingConfig(param_dict)
-
-        self.gradient_clipping = get_gradient_clipping(param_dict)
-        self.fp16_enabled = get_fp16_enabled(param_dict)
-        self.bfloat16_enabled = get_bfloat16_enabled(param_dict)
-        self.amp_enabled = get_amp_enabled(param_dict)
-        self.amp_params = get_amp_params(param_dict)
-        self.loss_scale = get_loss_scale(param_dict)
-        self.initial_dynamic_scale = get_initial_dynamic_scale(param_dict)
-        self.dynamic_loss_scale_args = get_dynamic_loss_scale_args(param_dict)
-
-        self.optimizer_name = get_optimizer_name(param_dict)
-        if self.optimizer_name is not None and \
-                self.optimizer_name.lower() in DEEPSPEED_OPTIMIZERS:
-            self.optimizer_name = self.optimizer_name.lower()
-
-        self.optimizer_params = get_optimizer_params(param_dict)
-        self.optimizer_legacy_fusion = get_optimizer_legacy_fusion(param_dict)
-
-        self.zero_allow_untested_optimizer = get_zero_allow_untested_optimizer(
-            param_dict)
-
-        self.scheduler_name = get_scheduler_name(param_dict)
-        self.scheduler_params = get_scheduler_params(param_dict)
-
-        self.wall_clock_breakdown = get_wall_clock_breakdown(param_dict)
         self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
-        self.memory_breakdown = get_memory_breakdown(param_dict)
-        self.tensorboard_enabled = get_tensorboard_enabled(param_dict)
-        self.tensorboard_output_path = get_tensorboard_output_path(param_dict)
-        self.tensorboard_job_name = get_tensorboard_job_name(param_dict)
 
-        self.sparse_attention = get_sparse_attention(param_dict)
-        self.pipeline = get_pipeline_config(param_dict)
+        self.sparse_attention = parse_sparse_attention(param_dict)
+        self.pipeline = dict(_PIPELINE_DEFAULTS,
+                             **param_dict.get("pipeline", {}))
 
-        self.pld_enabled = get_pld_enabled(param_dict)
-        self.pld_params = get_pld_params(param_dict)
-
-        checkpoint_params = get_checkpoint_params(param_dict)
-        validation_mode = get_checkpoint_tag_validation_mode(checkpoint_params)
+        tag_mode = str(_read(param_dict, (CHECKPOINT,
+                                          CHECKPOINT_TAG_VALIDATION),
+                             CHECKPOINT_TAG_VALIDATION_DEFAULT)).upper()
+        if tag_mode not in CHECKPOINT_TAG_VALIDATION_MODES:
+            raise ValueError(
+                "Checkpoint config contains invalid tag_validation "
+                "value of {}, expecting one of {}".format(
+                    tag_mode, CHECKPOINT_TAG_VALIDATION_MODES))
         self.checkpoint_tag_validation_enabled = \
-            validation_mode != ValidationMode.IGNORE
-        self.checkpoint_tag_validation_fail = validation_mode == ValidationMode.FAIL
+            tag_mode != ValidationMode.IGNORE
+        self.checkpoint_tag_validation_fail = tag_mode == ValidationMode.FAIL
 
     def _batch_assertion(self):
         train_batch = self.train_batch_size
@@ -636,11 +401,14 @@ class DeepSpeedConfig(object):
         grad_acc = self.gradient_accumulation_steps
 
         assert train_batch > 0, \
-            "Train batch size: {} has to be greater than 0".format(train_batch)
+            "Train batch size: {} has to be greater than 0".format(
+                train_batch)
         assert micro_batch > 0, \
-            "Micro batch size per gpu: {} has to be greater than 0".format(micro_batch)
+            "Micro batch size per gpu: {} has to be greater than 0".format(
+                micro_batch)
         assert grad_acc > 0, \
-            "Gradient accumulation steps: {} has to be greater than 0".format(grad_acc)
+            "Gradient accumulation steps: {} has to be greater than 0".format(
+                grad_acc)
         assert train_batch == micro_batch * grad_acc * self.world_size, (
             "Check batch related parameters. train_batch_size is not equal to "
             "micro_batch_per_gpu * gradient_acc_step * world_size "
@@ -650,7 +418,9 @@ class DeepSpeedConfig(object):
                                         self.world_size))
 
     def _set_batch_related_parameters(self):
-        """Batch triangle completion (reference config.py:675-721)."""
+        """Batch triangle completion (reference config.py:675-721): any two
+        of (total, micro, accumulation) imply the third; total alone means
+        no accumulation; micro alone means world-size scaling."""
         train_batch = self.train_batch_size
         micro_batch = self.train_micro_batch_size_per_gpu
         grad_acc = self.gradient_accumulation_steps
@@ -670,13 +440,14 @@ class DeepSpeedConfig(object):
             self.train_batch_size = micro_batch * grad_acc * self.world_size
         elif train_batch is not None:
             self.gradient_accumulation_steps = 1
-            self.train_micro_batch_size_per_gpu = train_batch // self.world_size
+            self.train_micro_batch_size_per_gpu = train_batch // \
+                self.world_size
         elif micro_batch is not None:
             self.train_batch_size = micro_batch * self.world_size
             self.gradient_accumulation_steps = 1
         else:
-            assert False, \
-                "Either train_batch_size or micro_batch_per_gpu needs to be provided"
+            assert False, ("Either train_batch_size or micro_batch_per_gpu "
+                           "needs to be provided")
 
     def _configure_train_batch_size(self):
         self._set_batch_related_parameters()
@@ -691,7 +462,8 @@ class DeepSpeedConfig(object):
         for arg in sorted(vars(self)):
             if arg != "_param_dict":
                 dots = "." * (29 - len(arg))
-                logger.info("  {} {} {}".format(arg, dots, getattr(self, arg)))
+                logger.info("  {} {} {}".format(arg, dots,
+                                                getattr(self, arg)))
         logger.info("  json = {}".format(
             json.dumps(self._param_dict,
                        sort_keys=True,
@@ -700,22 +472,27 @@ class DeepSpeedConfig(object):
 
     def _do_error_check(self):
         assert self.train_micro_batch_size_per_gpu, \
-            "DeepSpeedConfig: {} is not defined".format(TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+            "DeepSpeedConfig: {} is not defined".format(
+                TRAIN_MICRO_BATCH_SIZE_PER_GPU)
         assert self.gradient_accumulation_steps, \
-            "DeepSpeedConfig: {} is not defined".format(GRADIENT_ACCUMULATION_STEPS)
+            "DeepSpeedConfig: {} is not defined".format(
+                GRADIENT_ACCUMULATION_STEPS)
 
         if self.zero_enabled:
             # TPU delta: bf16 satisfies the mixed-precision requirement
             # (reference requires fp16: config.py:750-752).
             assert self.fp16_enabled or self.bfloat16_enabled, \
-                "DeepSpeedConfig: ZeRO is only supported if fp16 or bf16 is enabled"
-            assert self.zero_optimization_stage <= MAX_STAGE_ZERO_OPTIMIZATION, \
+                "DeepSpeedConfig: ZeRO is only supported if fp16 or bf16 " \
+                "is enabled"
+            assert self.zero_optimization_stage <= \
+                MAX_STAGE_ZERO_OPTIMIZATION, \
                 "DeepSpeedConfig: Maximum supported ZeRO stage is {}".format(
                     MAX_STAGE_ZERO_OPTIMIZATION)
             if self.zero_config.cpu_offload is True:
-                assert self.zero_optimization_stage == ZERO_OPTIMIZATION_GRADIENTS, \
-                    "DeepSpeedConfig: cpu-offload supported ZeRO stage is {}".format(
-                        ZERO_OPTIMIZATION_GRADIENTS)
+                assert self.zero_optimization_stage == \
+                    ZERO_OPTIMIZATION_GRADIENTS, \
+                    "DeepSpeedConfig: cpu-offload supported ZeRO stage is " \
+                    "{}".format(ZERO_OPTIMIZATION_GRADIENTS)
 
     def _do_warning_check(self):
         fp16_enabled = self.fp16_enabled or self.zero_enabled
@@ -724,9 +501,9 @@ class DeepSpeedConfig(object):
                                                VOCABULARY_SIZE_DEFAULT)
         if vocabulary_size and vocabulary_size % TENSOR_CORE_ALIGN_SIZE != 0:
             logger.warning(
-                "DeepSpeedConfig: vocabulary size {} is not aligned to {}, may "
-                "impact MXU utilization.".format(vocabulary_size,
-                                                 TENSOR_CORE_ALIGN_SIZE))
+                "DeepSpeedConfig: vocabulary size {} is not aligned to {}, "
+                "may impact MXU utilization.".format(vocabulary_size,
+                                                     TENSOR_CORE_ALIGN_SIZE))
 
         if self.optimizer_params is not None and \
                 MAX_GRAD_NORM in self.optimizer_params.keys() and \
@@ -736,11 +513,12 @@ class DeepSpeedConfig(object):
                     logger.warning(
                         "DeepSpeedConfig: In FP16 mode, DeepSpeed will pass "
                         "{}:{} to FP16 wrapper".format(
-                            MAX_GRAD_NORM, self.optimizer_params[MAX_GRAD_NORM]))
+                            MAX_GRAD_NORM,
+                            self.optimizer_params[MAX_GRAD_NORM]))
             else:
                 if self.global_rank == 0:
                     logger.warning(
                         "DeepSpeedConfig: In FP32 mode, DeepSpeed does not "
-                        "permit MAX_GRAD_NORM ({}) > 0, setting to zero".format(
-                            self.optimizer_params[MAX_GRAD_NORM]))
+                        "permit MAX_GRAD_NORM ({}) > 0, setting to "
+                        "zero".format(self.optimizer_params[MAX_GRAD_NORM]))
                 self.optimizer_params[MAX_GRAD_NORM] = 0.0
