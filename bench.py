@@ -76,6 +76,87 @@ def _device_probe(budget=480, attempt_timeout=180, probe=_probe_once,
         backoff = min(backoff * 2, 120)
 
 
+def _require_tpu_or_exit():
+    """Inner-process guard: under the supervisor, a run that silently came
+    up on CPU must FAIL so the supervisor retries / falls back with the
+    last-good artifact instead of relaying a 40x-looking CPU number."""
+    import jax
+
+    if os.environ.get("DS_BENCH_REQUIRE_TPU") and \
+            jax.default_backend() != "tpu":
+        print("bench: inner run required TPU but got {}".format(
+            jax.default_backend()), file=sys.stderr)
+        sys.exit(3)
+
+
+def _run_inner(argv, timeout):
+    """One subprocess attempt at the real measurement; returns (stdout
+    JSON lines, error reason)."""
+    env = dict(os.environ, DS_BENCH_INNER="1", DS_BENCH_REQUIRE_TPU="1")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + argv,
+            timeout=timeout, capture_output=True, text=True, env=env)
+    except subprocess.TimeoutExpired:
+        return None, "inner bench timed out after {:.0f}s".format(timeout)
+    if r.stderr:
+        sys.stderr.write(r.stderr[-4000:])
+    lines = [l for l in (r.stdout or "").splitlines() if l.strip()]
+    if r.returncode == 0 and lines:
+        return lines, ""
+    return None, "rc={}".format(r.returncode)
+
+
+def _supervise(argv, sleep=time.sleep, probe=None, inner=None):
+    """Run the measurement in retried SUBPROCESSES.
+
+    Round 2's wedge hit at device init; round 3's hit 25 minutes in, at
+    compile time ('UNAVAILABLE: TPU backend setup/compile error') — after
+    the probe had already passed. Supervising the whole run means ANY
+    failure stage (init, compile, runtime) re-enters the backoff loop;
+    only after the wall budget is spent does the harness fall back to the
+    CPU smoke with the last-good TPU artifact embedded."""
+    probe = probe or _device_probe
+    inner = inner or _run_inner
+    budget = float(os.environ.get("DS_BENCH_BUDGET", "1500"))
+    deadline = time.time() + budget
+    backoff = 20
+    attempt = 0
+    while True:
+        remaining = deadline - time.time()
+        if remaining < 120:
+            break  # too little time left for any real attempt
+        attempt += 1
+        if probe(budget=min(480, remaining)):
+            lines, reason = inner(argv, timeout=remaining)
+            if lines is not None:
+                for line in lines:
+                    print(line)
+                return 0
+        else:
+            # An init-stage wedge can clear when the stale grant expires —
+            # keep retrying (with backoff) until the wall budget is spent,
+            # same as any other failure stage.
+            reason = "device probe gave up"
+        print("bench: run attempt {} failed ({})".format(attempt, reason),
+              file=sys.stderr)
+        wait = min(backoff, deadline - time.time())
+        if wait > 0:
+            print("bench: retrying run in {:.0f}s".format(wait),
+                  file=sys.stderr)
+            sleep(wait)
+        backoff = min(backoff * 2, 180)
+    print("bench: falling back to CPU", file=sys.stderr)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["DS_BENCH_FALLBACK"] = "accelerator-init-failed"
+    # sitecustomize pins jax_platforms at interpreter startup; the env
+    # var alone is not consulted again (see tests/conftest.py).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return _dispatch(argv)
+
+
 def _load_last_good(metric):
     """Last driver-visible TPU bench line FOR ``metric``, or None.
 
@@ -172,6 +253,8 @@ def main_xl():
     on a tunneled dev TPU costs minutes, not the sub-second of local PCIe."""
     import jax
 
+    _require_tpu_or_exit()
+
     import deepspeed_tpu as deepspeed
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
 
@@ -228,7 +311,9 @@ def main_xl():
     })
 
 
-def main():
+def _measure_gpt2(batch, seq, steps):
+    """One timed GPT-2 355M training run (tiny model off-TPU); returns the
+    result dict (not yet emitted)."""
     import jax
 
     import deepspeed_tpu as deepspeed
@@ -243,7 +328,6 @@ def main():
         # (2.1x over dense XLA at T=1024 fwd+bwd); chunked-XE loss keeps
         # logits out of HBM so batch 8 fits without remat.
         cfg = GPT2Config.gpt2_medium(dropout=0.0, use_flash_attention=True)
-        batch, seq, steps = 8, 1024, 20
         peak_flops = 197e12  # v5e bf16 peak per chip
     else:
         cfg = GPT2Config.tiny(dropout=0.0)
@@ -284,7 +368,7 @@ def main():
     tokens_per_sec_per_chip = tokens / dt / jax.device_count()
     mfu = tokens_per_sec_per_chip * flops_per_token(cfg, seq) / peak_flops
 
-    _emit({
+    return {
         "metric": "gpt2_{}_tokens_per_sec_per_chip".format(
             "355m" if on_tpu else "tiny"),
         "value": round(tokens_per_sec_per_chip, 1),
@@ -294,20 +378,63 @@ def main():
             "mfu": round(mfu, 4),
             "platform": platform,
             "devices": jax.device_count(),
+            "batch": batch,
+            "seq": seq,
             "loss": loss,
             "params": cfg.num_params(),
         },
-    })
+    }
+
+
+def main():
+    _require_tpu_or_exit()
+    _emit(_measure_gpt2(batch=8, seq=1024, steps=20))
+
+
+def main_sweep():
+    """`bench.py --sweep`: tok/s + MFU over a {batch} x {seq} grid at 355M,
+    one JSON line per config (the TPU analogue of the reference's
+    tests/model/Megatron_GPT2/run_perf_baseline.py config sweep). The
+    grid's rows at fixed tokens-per-step show the batch/HBM trade; the
+    headline (b8 x T1024) is part of the grid. Each config runs in THIS
+    process sequentially — one backend init, engines built per config."""
+    _require_tpu_or_exit()
+    for batch, seq in ((8, 1024), (12, 1024), (16, 1024), (4, 2048),
+                       (8, 2048), (2, 4096), (4, 4096)):
+        r = _measure_gpt2(batch=batch, seq=seq, steps=10)
+        # Name by the ACTUAL measured config (off-TPU the measurement
+        # degrades to the tiny smoke model — the metric must say so, and
+        # routing through _emit keeps the fallback marker / last-good
+        # bookkeeping that raw json.dumps would silently drop).
+        r["metric"] = "sweep_{}_b{}_t{}".format(
+            r["metric"], r["extra"]["batch"], r["extra"]["seq"])
+        _emit(r)
+        if r["extra"]["platform"] != "tpu":
+            break  # off-TPU every grid entry degrades to the same smoke
+    return 0
+
+
+def _dispatch(argv):
+    if "--sweep" in argv:
+        return main_sweep()
+    if "--xl" in argv:
+        return main_xl()
+    return main()
 
 
 if __name__ == "__main__":
-    if not _device_probe():
-        print("bench: falling back to CPU", file=sys.stderr)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ["DS_BENCH_FALLBACK"] = "accelerator-init-failed"
-        # sitecustomize pins jax_platforms at interpreter startup; the env
-        # var alone is not consulted again (see tests/conftest.py).
+    argv = sys.argv[1:]
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # Explicit CPU request. sitecustomize pins jax_platforms at
+        # interpreter startup, so the env var alone would still dial the
+        # accelerator relay (and hang on a held grant) — force it.
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    sys.exit(main_xl() if "--xl" in sys.argv[1:] else main())
+        sys.exit(_dispatch(argv))
+    if os.environ.get("DS_BENCH_INNER") == "1" or \
+            not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        # Inner supervised run, or a non-relay environment (healthy local
+        # deployment / CI): run the measurement directly.
+        sys.exit(_dispatch(argv))
+    sys.exit(_supervise(argv))

@@ -24,6 +24,16 @@ than the matmuls. The design therefore minimises score-matrix passes:
 - when the kv extent is a single block, the online-softmax machinery
   (running max/sum scratch, accumulator rescale) collapses to one direct
   softmax with no scratch at all;
+- the softmax ROW-SUM rides the PV matmul: p @ [v | 1] returns the context
+  block and the row-sum from one MXU op, deleting a VPU reduce over
+  [bq, bk] (forward);
+- in the backward, the delta subtraction rides the dp matmul the same way:
+  [dO | -delta] @ [V | 1]^T produces dp - delta directly (fp32 MXU
+  accumulation), deleting another [bq, bk] VPU pass;
+- in low-precision models the [bq, bk] exp runs in the model dtype (half
+  the vector elements per VPU op) and dp - delta is emitted in the model
+  dtype, so ds = p * dpd is a pure low-precision multiply; fp32 models
+  keep fully-fp32 intermediates (parity tests pin this);
 - matmul inputs stay in the model dtype (bf16) with fp32 MXU accumulation
   (preferred_element_type); softmax statistics and accumulators live in
   fp32 VMEM scratch across grid steps;
@@ -117,6 +127,76 @@ def _tril_block(block_q, block_k):
     return jnp.where(r >= c, jnp.float32(0.0), jnp.float32(NEG_INF))
 
 
+def _is_lowp(dtype):
+    return jnp.dtype(dtype) in (jnp.bfloat16, jnp.float16)
+
+
+def _exp_lowp(t, dtype):
+    """exp over a [bq, bk] block — the widest VPU pass in the kernel.
+
+    Low-precision models run the exp in the model dtype: half the vector
+    elements per VPU op, and the result feeds the next matmul without a
+    cast pass. Absolute error is ~p * |t| * 2^-8 <= e^-1 * 2^-8 relative
+    to the row total — the same order as the fp32-exp-then-cast-to-bf16 it
+    replaces. fp32 models keep the fp32 exp (parity tests pin 1e-4)."""
+    if _is_lowp(dtype):
+        return jnp.exp(t.astype(dtype))
+    return jnp.exp(t)
+
+
+def _pv_rowsum(p, v_blk):
+    """p @ [v | 1] on the MXU: one matmul returns both the context block
+    [bq, d] and the softmax row-sum [bq, 1], deleting a VPU reduce over
+    [bq, bk]. The row-sum shares p's rounding with the context numerator,
+    so o = pv / l normalizes exactly the values it summed."""
+    d = v_blk.shape[1]
+    v_ext = jnp.concatenate(
+        [v_blk, jnp.ones((v_blk.shape[0], 1), v_blk.dtype)], axis=1)
+    pv_ext = jax.lax.dot_general(p.astype(v_blk.dtype), v_ext,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    return pv_ext[:, :d], pv_ext[:, d:d + 1]
+
+
+def _dp_minus_delta(do, v_blk, delta):
+    """[dO | -delta] @ [V | 1]^T on the MXU: the delta subtraction rides
+    the dp matmul (fp32 accumulation inside the MXU) instead of costing a
+    VPU pass over [bq, bk]. Low-precision models split the fp32 delta into
+    hi+lo model-dtype COLUMNS (~16 mantissa bits through the MXU): rows
+    with concentrated attention have dp ~ delta and p ~ 1, so a single
+    bf16 delta column's 2^-8 rounding would surface at full scale in
+    ds = p * (dp - delta). The output is emitted in the model dtype — its
+    rounding is relative to the (small) difference, not to delta — making
+    ds a pure low-precision multiply.
+
+    Only bf16 takes the fused columns: bf16 shares fp32's exponent range,
+    so the delta split never overflows. fp16 does NOT — under dynamic loss
+    scaling delta = rowsum(dO * O) routinely exceeds fp16 max (65504) even
+    when every dO element fits, and an inf hi column would turn the MXU
+    accumulation into NaN — so fp16 keeps the classic fp32 subtract. fp32
+    models ride an exact fp32 delta column (exact parity)."""
+    dtype = v_blk.dtype
+    if jnp.dtype(dtype) == jnp.bfloat16:
+        d_hi = delta.astype(dtype)
+        d_lo = (delta - d_hi.astype(jnp.float32)).astype(dtype)
+        do_ext = jnp.concatenate([do.astype(dtype), -d_hi, -d_lo], axis=1)
+        ones = jnp.ones((v_blk.shape[0], 2), dtype)
+        v_ext = jnp.concatenate([v_blk, ones], axis=1)
+        return jax.lax.dot_general(do_ext, v_ext, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=dtype)
+    if _is_lowp(dtype):  # fp16: unfused fp32 subtract (overflow-safe)
+        dp = jax.lax.dot_general(do.astype(dtype), v_blk,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        return dp - delta
+    do_ext = jnp.concatenate(
+        [do.astype(dtype), (-delta).astype(dtype)], axis=1)
+    v_ext = jnp.concatenate(
+        [v_blk, jnp.ones((v_blk.shape[0], 1), dtype)], axis=1)
+    return jax.lax.dot_general(do_ext, v_ext, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
 def _apply_causal(s, iq, j, block_q, block_k, tril_ref):
     """Apply the causal mask to score block (iq, j). With bq == bk only the
     diagonal block straddles the boundary, so the constant tril input is
@@ -167,12 +247,9 @@ def _fwd_kernel(*refs, causal, block_q, block_k, has_mask, has_tril,
         # One kv block: direct softmax, no scratch, no rescale passes.
         s = scores()
         m = jnp.max(s, axis=-1, keepdims=True)
-        p = jnp.exp(s - m)
-        l = jnp.sum(p, axis=-1, keepdims=True)
-        v_blk = v_ref[0, 0]
-        pv = jax.lax.dot_general(p.astype(v_blk.dtype), v_blk,
-                                 (((1,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+        p = _exp_lowp(s - m, o_ref.dtype)
+        pv, l = _pv_rowsum(p, v_ref[0, 0])
+        l = jnp.maximum(l, 1e-30)
         o_ref[0, 0] = (pv / l).astype(o_ref.dtype)
         lse_ref[0, 0] = m + jnp.log(l)
         return
@@ -198,15 +275,11 @@ def _fwd_kernel(*refs, causal, block_q, block_k, has_mask, has_tril,
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)                             # [bq, bk] fp32
-        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        p = _exp_lowp(s - m_new, o_ref.dtype)              # [bq, bk]
+        pv, l_cur = _pv_rowsum(p, v_ref[0, 0])
+        l_new = alpha * l_prev + l_cur
         m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
         l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
-        # Second MXU matmul in the model dtype with fp32 accumulation.
-        v_blk = v_ref[0, 0]
-        pv = jax.lax.dot_general(p.astype(v_blk.dtype), v_blk,
-                                 (((1,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
         acc[...] = acc[...] * alpha + pv
 
     @pl.when(j == n_kv - 1)
@@ -333,13 +406,9 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, has_mask,
         # s <= lse mathematically; clamping guards fully-masked rows where
         # fp32 lse (~mask magnitude, ulp 64) loses the log-sum bits and a
         # spurious positive exponent would poison the step with inf grads.
-        p = jnp.exp(jnp.minimum(s - lse_ref[0, 0], 0.0))   # [bq, bk] fp32
-        v_blk = v_ref[0, 0]
-        do = do_ref[0, 0]
-        dp = jax.lax.dot_general(do.astype(v_blk.dtype), v_blk,
-                                 (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta_ref[0, 0])).astype(k_ref.dtype)
+        p = _exp_lowp(jnp.minimum(s - lse_ref[0, 0], 0.0), dq_ref.dtype)
+        dpd = _dp_minus_delta(do_ref[0, 0], v_ref[0, 0], delta_ref[0, 0])
+        ds = (p * dpd).astype(k_ref.dtype)
         return jax.lax.dot_general(ds, k_ref[0, 0], (((1,), (0,)), ((), ())),
                                    preferred_element_type=jnp.float32)
 
@@ -382,16 +451,13 @@ def _bwd_dkv_kernel(*refs, causal, block_q, block_k, has_mask, has_tril,
         # s <= lse mathematically; clamping guards fully-masked rows where
         # fp32 lse (~mask magnitude, ulp 64) loses the log-sum bits and a
         # spurious positive exponent would poison the step with inf grads.
-        p = jnp.exp(jnp.minimum(s - lse_ref[0, 0], 0.0))   # [bq, bk] fp32
+        p = _exp_lowp(jnp.minimum(s - lse_ref[0, 0], 0.0), dk_ref.dtype)
         do = do_ref[0, 0]
-        p_cast = p.astype(do.dtype)
-        dv = jax.lax.dot_general(p_cast, do, (((0,), (0,)), ((), ())),
+        dv = jax.lax.dot_general(p.astype(do.dtype), do,
+                                 (((0,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        v_blk = v_ref[0, 0]
-        dp = jax.lax.dot_general(do.astype(v_blk.dtype), v_blk,
-                                 (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta_ref[0, 0])).astype(q_ref.dtype)
+        dpd = _dp_minus_delta(do, v_ref[0, 0], delta_ref[0, 0])
+        ds = (p * dpd).astype(q_ref.dtype)
         dk = jax.lax.dot_general(ds, q_ref[0, 0], (((0,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         return dk, dv

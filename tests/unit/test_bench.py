@@ -169,6 +169,90 @@ def test_emit_cpu_run_does_not_touch_last_good(bench, monkeypatch, tmp_path,
     assert not p.exists()
 
 
+def test_supervisor_relays_inner_success(bench, monkeypatch, capsys):
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    rc = bench._supervise(
+        [], probe=lambda budget: True,
+        inner=lambda argv, timeout: (['{"metric": "m", "value": 1}'], ""))
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out.strip())["metric"] == "m"
+
+
+def test_supervisor_retries_failed_inner_run(bench, monkeypatch, capsys):
+    """A run that dies AFTER the probe (round 3: compile-stage UNAVAILABLE
+    25 minutes in) must be retried, not crash the harness."""
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("DS_BENCH_BUDGET", "1000")
+    clock = FakeClock()
+    monkeypatch.setattr(bench.time, "time", clock.time)
+    calls = []
+
+    def inner(argv, timeout):
+        clock.t += 100
+        calls.append(timeout)
+        if len(calls) < 3:
+            return None, "rc=1"
+        return ['{"metric": "m", "value": 2}'], ""
+
+    rc = bench._supervise([], sleep=clock.sleep,
+                          probe=lambda budget: True, inner=inner)
+    assert rc == 0
+    assert len(calls) == 3
+    assert json.loads(capsys.readouterr().out.strip())["value"] == 2
+
+
+def test_supervisor_retries_after_probe_giveup(bench, monkeypatch, capsys):
+    """An init-stage wedge can clear when the stale grant expires — a probe
+    give-up must re-enter the backoff loop, not fall straight back to CPU
+    with most of the wall budget unspent."""
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("DS_BENCH_BUDGET", "1000")
+    clock = FakeClock()
+    monkeypatch.setattr(bench.time, "time", clock.time)
+    probes = []
+
+    def probe(budget):
+        clock.t += 50
+        probes.append(budget)
+        return len(probes) >= 2  # wedged once, then the grant expires
+
+    rc = bench._supervise(
+        [], sleep=clock.sleep, probe=probe,
+        inner=lambda argv, timeout: (['{"metric": "m", "value": 3}'], ""))
+    assert rc == 0
+    assert len(probes) == 2
+    assert json.loads(capsys.readouterr().out.strip())["value"] == 3
+
+
+def test_supervisor_falls_back_after_budget(bench, monkeypatch, capsys):
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("DS_BENCH_BUDGET", "300")
+    clock = FakeClock()
+    monkeypatch.setattr(bench.time, "time", clock.time)
+    fell_back = []
+
+    def fake_dispatch(argv):
+        fell_back.append(os.environ.get("DS_BENCH_FALLBACK"))
+        return 0
+
+    monkeypatch.setattr(bench, "_dispatch", fake_dispatch)
+    monkeypatch.setattr(bench, "jax", None, raising=False)
+
+    def inner(argv, timeout):
+        clock.t += 200
+        return None, "rc=1"
+
+    # Fake the jax import inside the fallback tail.
+    import types
+    fake_jax = types.SimpleNamespace(
+        config=types.SimpleNamespace(update=lambda *a: None))
+    monkeypatch.setitem(__import__("sys").modules, "jax", fake_jax)
+    rc = bench._supervise([], sleep=clock.sleep,
+                          probe=lambda budget: True, inner=inner)
+    assert rc == 0
+    assert fell_back == ["accelerator-init-failed"]
+
+
 def test_committed_last_good_artifact_is_valid():
     # Shape-only: bench.py rewrites this file with measured values, so
     # asserting any particular ratio would fail on an honest slow run.

@@ -85,6 +85,63 @@ def test_flash_attention_backward_cross_lengths(t_q, t_kv, blk):
         np.testing.assert_allclose(a, b_, rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("multi_block", [False, True])
+def test_flash_attention_bf16_lowp_path(causal, multi_block):
+    """bf16 models take the low-precision kernel branch (model-dtype exp,
+    MXU-fused row-sum and delta subtraction) — parity vs the fp32 dense
+    reference at bf16-appropriate tolerances, fwd and bwd."""
+    rng = np.random.RandomState(11)
+    b, h, t, d = 2, 2, 128, 32
+    blk = 64 if multi_block else 128
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+
+    o = flash_attention(q, k, v, causal=causal, block_q=blk, block_k=blk)
+    ref = mha_reference(q.astype(jnp.float32), k.astype(jnp.float32),
+                        v.astype(jnp.float32), causal=causal)
+    np.testing.assert_allclose(np.asarray(o, np.float32), ref,
+                               rtol=5e-2, atol=2e-2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       block_q=blk, block_k=blk)
+                       .astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        v.astype(jnp.float32))
+    for a, b_ in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32), b_,
+                                   rtol=1e-1, atol=5e-2)
+
+
+def test_flash_attention_fp16_loss_scaled_grads_finite():
+    """Under dynamic loss scaling, delta = rowsum(dO * O) can exceed fp16
+    max even when every dO element fits in fp16 — the kernel must keep the
+    delta subtraction in fp32 for fp16 models (a fused fp16 delta column
+    would go inf and NaN the MXU accumulation)."""
+    rng = np.random.RandomState(2)
+    b, h, t, d = 1, 1, 64, 64
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.float16)
+    k = jnp.asarray(rng.randn(b, h, t, d), jnp.float16)
+    v = jnp.asarray(50.0 + rng.rand(b, h, t, d), jnp.float16)
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+        # Scaled loss: dO ~ 50 elementwise; delta ~ 50*50*64 >> 65504.
+        return jnp.sum(o.astype(jnp.float32) * 50.0)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a in g:
+        assert np.isfinite(np.asarray(a, np.float32)).all()
+
+
 def test_flash_attention_ragged_fallback():
     # Non-divisible seq lengths take the jnp path; result must still match.
     rng = np.random.RandomState(5)
