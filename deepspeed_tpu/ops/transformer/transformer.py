@@ -42,8 +42,10 @@ from deepspeed_tpu.ops.transformer.kernels.layer_norm import (
 @dataclasses.dataclass
 class DeepSpeedTransformerConfig:
     """Config surface of the reference DeepSpeedTransformerConfig
-    (ops/transformer/transformer.py:39-150). CUDA-specific knobs
-    (local_rank, stochastic_mode) are accepted for compatibility;
+    (ops/transformer/transformer.py:39-150). local_rank is accepted for
+    compatibility (single-controller JAX has no per-process rank here);
+    stochastic_mode maps to the TPU precision-for-speed trade (fp32
+    layers run attention on the bf16 kernel fast path — see _attention);
     fp16 selects bf16 compute on TPU unless fp16 is forced."""
 
     batch_size: int = -1
@@ -133,9 +135,22 @@ class DeepSpeedTransformerLayer(nn.Module):
         q = q.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
         k = k.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
         v = v.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+        # stochastic_mode: the reference registers distinct faster,
+        # non-bit-reproducible training kernels for this flag
+        # (csrc/transformer/ds_transformer_cuda.cpp:1011-1028). The TPU
+        # equivalent trade is precision-for-speed: an fp32 layer drops its
+        # attention to the bf16 kernel fast path (model-dtype exp, fused
+        # MXU row-sum/delta — ops/transformer/kernels/attention.py). bf16
+        # layers already take that path, matching the reference's note
+        # that stochastic mode mainly pays off in half precision.
+        stochastic_lowp = cfg.stochastic_mode and dt == jnp.float32
+        if stochastic_lowp:
+            q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))
 
         def attn_fn(q, k, v):
             ctx = flash_attention(q, k, v, mask=attention_mask, causal=False)
+            if stochastic_lowp:
+                ctx = ctx.astype(dt)
             if cfg.attn_dropout_ratio > 0 and not deterministic:
                 # Flash never materialises probs, so attention dropout moves
                 # to the context output (same regularisation role as

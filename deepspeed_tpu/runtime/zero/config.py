@@ -29,6 +29,8 @@ from deepspeed_tpu.runtime.zero.constants import (
     ZERO_OPTIMIZATION_REDUCE_SCATTER_DEFAULT,
     ZERO_OPTIMIZATION_STAGE,
     ZERO_OPTIMIZATION_STAGE_DEFAULT,
+    ZERO_OPTIMIZATION_STREAM_GRADIENTS,
+    ZERO_OPTIMIZATION_STREAM_GRADIENTS_DEFAULT,
 )
 
 
@@ -42,6 +44,7 @@ class DeepSpeedZeroConfig(object):
         self.allgather_bucket_size = None
         self.overlap_comm = None
         self.cpu_offload = None
+        self.stream_gradients = None
         self.elastic_checkpoint = None
         self.load_from_fp32_weights = None
 
@@ -96,6 +99,10 @@ class DeepSpeedZeroConfig(object):
             zero_config_dict,
             ZERO_OPTIMIZATION_CPU_OFFLOAD,
             ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT)
+        self.stream_gradients = get_scalar_param(
+            zero_config_dict,
+            ZERO_OPTIMIZATION_STREAM_GRADIENTS,
+            ZERO_OPTIMIZATION_STREAM_GRADIENTS_DEFAULT)
         self.elastic_checkpoint = get_scalar_param(
             zero_config_dict,
             ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT,

@@ -1,0 +1,92 @@
+#!/bin/bash
+# Multi-node source install for deepspeed_tpu (behavioral mirror of the
+# reference's install.sh: build a wheel once, install it on every host in
+# an MPI-style hostfile via pdsh, or locally with --local_only).
+#
+# On TPU pods the per-host runtime is identical (no per-arch CUDA builds),
+# so the same wheel ships everywhere; C++ host ops JIT-compile per host on
+# first use through op_builder (hash-keyed cache), which replaces the
+# reference's prebuilt-op wheels.
+
+set -e
+err_report() {
+    echo "Error on line $1"
+    echo "Failed to install deepspeed_tpu"
+}
+trap 'err_report $LINENO' ERR
+
+usage() {
+  cat <<'USAGE'
+Usage: install.sh [options...]
+
+Installs deepspeed_tpu on every host in the hostfile (default:
+/job/hostfile, MPI-style "hostname slots=N" lines). With no hostfile,
+installs locally only.
+
+Options:
+    -l, --local_only        Install only on the local machine
+    -s, --pip_sudo          Run pip install with sudo
+    -n, --no_clean          Keep prior build state (default: clean first)
+    -m, --pip_mirror URL    Use the given pip index mirror
+    -H, --hostfile PATH     MPI-style hostfile (default: /job/hostfile)
+    -h, --help              This help text
+USAGE
+}
+
+local_only=0
+pip_sudo=0
+no_clean=0
+hostfile=/job/hostfile
+pip_mirror=""
+
+while [[ $# -gt 0 ]]; do
+    case $1 in
+        -l|--local_only) local_only=1; shift ;;
+        -s|--pip_sudo) pip_sudo=1; shift ;;
+        -n|--no_clean) no_clean=1; shift ;;
+        -m|--pip_mirror) pip_mirror=$2; shift 2 ;;
+        -H|--hostfile) hostfile=$2; shift 2 ;;
+        -h|--help) usage; exit 0 ;;
+        *) echo "Unknown option: $1"; usage; exit 1 ;;
+    esac
+done
+
+here="$(cd "$(dirname "$0")" && pwd)"
+cd "$here"
+
+pip_cmd="python -m pip"
+if [[ $pip_sudo == 1 ]]; then pip_cmd="sudo -H python -m pip"; fi
+pip_flags=""
+if [[ -n $pip_mirror ]]; then pip_flags="-i $pip_mirror"; fi
+
+if [[ $no_clean == 0 ]]; then
+    rm -rf dist build *.egg-info
+fi
+
+echo "Building deepspeed_tpu wheel..."
+python setup.py -q bdist_wheel
+wheel=$(ls dist/*.whl | head -1)
+echo "Built $wheel"
+
+install_local() {
+    $pip_cmd uninstall -y deepspeed-tpu 2>/dev/null || true
+    $pip_cmd install $pip_flags "$wheel"
+    python -m deepspeed_tpu.env_report || true
+}
+
+if [[ $local_only == 1 || ! -f $hostfile ]]; then
+    if [[ ! -f $hostfile && $local_only == 0 ]]; then
+        echo "No hostfile at $hostfile — installing locally only."
+    fi
+    install_local
+    exit 0
+fi
+
+# Multi-node: ship the wheel to every host, then install everywhere.
+hosts=$(awk 'NF && $1 !~ /^#/ {print $1}' "$hostfile" | paste -sd, -)
+echo "Installing on hosts: $hosts"
+tmp_wheel="/tmp/$(basename "$wheel")"
+pdcp -w "$hosts" "$wheel" "$tmp_wheel"
+pdsh -w "$hosts" "$pip_cmd uninstall -y deepspeed-tpu 2>/dev/null; \
+    $pip_cmd install $pip_flags $tmp_wheel && rm -f $tmp_wheel"
+echo "Done. Verify with: pdsh -w $hosts python -m deepspeed_tpu.env_report"

@@ -1,0 +1,103 @@
+"""Peak params/chip capacity probe — mirrors the reference's ZeRO-Offload
+headline (13B on one 32 GB V100, docs/_posts/2020-09-09-ZeRO-Offload.md:10)
+on this chip: walk GPT configs upward until a full offload train step no
+longer completes, recording params, step wall time, and the HBM/host
+split at each rung.
+
+Accounting that decides the ceiling here: with ZeRO-2 + cpu_offload the
+device holds bf16 params (2 B/param) AND the jit-produced bf16 grads
+(2 B/param) simultaneously (XLA emits all grads in one program; unlike
+torch autograd nothing frees incrementally), so a 16 GB chip binds near
+4 B/param => ~3.5B; the host holds fp32 master+m+v (12 B/param) plus the
+staged fp32 grads (4 B/param) => ~7B per 118 GB. Whichever trips first is
+the measured ceiling.
+
+Usage: python tests/perf/capacity_probe.py [--seq 512] [--start 0]
+Writes one JSON line per rung to stdout; stderr carries progress.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+# (label, n_embd, n_layer) — params ~= 12*L*C^2 + 50257*C + pos
+RUNGS = [
+    ("1.5b", 1600, 48),
+    ("2.1b", 1920, 48),
+    ("2.7b", 2560, 34),   # GPT-3 2.7B-ish width
+    ("3.2b", 2560, 41),
+    ("4.0b", 2560, 51),
+    ("5.0b", 2880, 50),
+    ("6.2b", 3072, 55),
+]
+
+
+def probe_rung(label, n_embd, n_layer, seq):
+    import jax
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    heads = max(8, n_embd // 128)
+    while n_embd % heads:
+        heads -= 1
+    cfg = GPT2Config(n_embd=n_embd, n_layer=n_layer, n_head=heads,
+                     dropout=0.0, remat=True)
+    params = cfg.num_params()
+    print("probe {}: C={} L={} => {:.2f}B params".format(
+        label, n_embd, n_layer, params / 1e9), file=sys.stderr)
+    engine, _, _, _ = deepspeed.initialize(
+        model=GPT2LMHeadModel(cfg),
+        config_params={
+            "train_batch_size": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2, "cpu_offload": True},
+        })
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, size=(1, seq))
+    t0 = time.time()
+    loss = engine(ids, ids)
+    engine.backward(loss)
+    engine.step()
+    step_s = time.time() - t0
+    loss = float(loss)
+    dev = jax.local_devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: {})() or {}
+    result = {
+        "rung": label,
+        "params": params,
+        "step_seconds": round(step_s, 1),
+        "loss": loss,
+        "hbm_peak_bytes": stats.get("peak_bytes_in_use"),
+        "offload_timing": engine.offload_timing(),
+    }
+    # Free everything before the next (bigger) rung.
+    engine.params = None
+    del engine
+    return result
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--start", type=int, default=0,
+                    help="rung index to start from")
+    args = ap.parse_args()
+    for label, c, l in RUNGS[args.start:]:
+        try:
+            r = probe_rung(label, c, l, args.seq)
+        except Exception as e:  # OOM (device or host) ends the walk
+            print(json.dumps({"rung": label, "failed": str(e)[-500:]}))
+            print("probe {}: FAILED — ceiling is the previous rung"
+                  .format(label), file=sys.stderr)
+            return 0
+        print(json.dumps(r))
+        sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

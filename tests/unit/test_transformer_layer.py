@@ -113,6 +113,23 @@ def test_dropout_training_mode_stochastic():
                                   np.asarray(train_out2))
 
 
+def test_stochastic_mode_fast_path_tracks_fp32():
+    """stochastic_mode on an fp32 layer takes the bf16 attention fast path
+    (the TPU mapping of the reference's faster non-reproducible stochastic
+    kernels): output must track the exact fp32 layer at bf16 tolerance."""
+    b, t, h, nh = 2, 64, 128, 4
+    layer, cfg, params, x = make_layer(b, t, h, nh, True)
+    s_layer, _, s_params, _ = make_layer(b, t, h, nh, True,
+                                         stochastic_mode=True)
+    exact = layer.apply({"params": params}, x)
+    fast = s_layer.apply({"params": s_params}, x)
+    assert fast.dtype == exact.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(fast), np.asarray(exact),
+                               rtol=5e-2, atol=2e-2)
+    # And it must not be bit-identical — the fast path really engaged.
+    assert not np.array_equal(np.asarray(fast), np.asarray(exact))
+
+
 def test_config_from_dict():
     cfg = DeepSpeedTransformerConfig.from_dict({
         "batch_size": 8, "hidden_size": 128, "heads": 4,
