@@ -8,6 +8,7 @@ API façade mirrors reference deepspeed/__init__.py: ``initialize()`` returns
 of NCCL/torch.distributed).
 """
 
+from deepspeed_tpu import moe  # noqa: F401
 from deepspeed_tpu import ops  # noqa: F401
 from deepspeed_tpu.runtime.activation_checkpointing import checkpointing  # noqa: F401
 from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401

@@ -1,0 +1,100 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+`MoE` mirrors the DeepSpeed-MoE user surface (later-release
+`deepspeed/moe/layer.py`: construct with a sub-`expert` module, call on
+[B, T, C] hidden states, get `(output, l_aux, exp_counts)` back) on a
+TPU-native implementation:
+
+- experts are ONE stacked parameter tree with a leading [num_experts]
+  axis (`nn.vmap` over the expert module) — a single pytree leaf per
+  weight, so ZeRO/optimizer/checkpoint plumbing needs no special cases;
+- EXPERT PARALLELISM is a sharding rule, not a process group: the expert
+  axis shards over the mesh's 'model' axis
+  (parallel/mesh.py DEFAULT_TP_RULES), and the dispatch/combine einsums
+  (sharded_moe.py) let XLA insert the token all-to-alls — the CUDA
+  implementation's explicit expert-parallel comm groups and a2a calls
+  have no analogue here because GSPMD derives them;
+- routing is fixed-shape capacity-based dense math (MXU-friendly), so
+  the layer jits once.
+"""
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepspeed_tpu.moe.sharded_moe import top1gating, top2gating
+
+
+class Experts(nn.Module):
+    """num_experts stacked copies of the expert module: parameters get a
+    leading expert axis (the axis expert parallelism shards)."""
+
+    expert: Callable[[], nn.Module]
+    num_experts: int
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [E, cap, C] — one row of tokens per expert.
+        vmapped = nn.vmap(
+            lambda mdl, xi: mdl(xi),
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=0, out_axes=0,
+            axis_size=self.num_experts)
+        return vmapped(self.expert(), x)
+
+
+class MoE(nn.Module):
+    """Sparsely-gated mixture-of-experts block.
+
+    Args mirror the DeepSpeed MoE constructor: ``hidden_size``,
+    ``expert`` (a zero-arg factory returning the expert flax module, e.g.
+    ``lambda: MLP(cfg)``), ``num_experts``, ``k`` (1 or 2),
+    ``capacity_factor`` / ``eval_capacity_factor``, ``min_capacity``,
+    ``noisy_gate_policy`` (None or 'Jitter').
+
+    Call: ``out, l_aux, exp_counts = moe(x, deterministic=...)`` with x
+    [B, T, C]. Add ``l_aux`` (scaled by your aux coefficient) to the
+    training loss; dropped-by-capacity tokens ride the residual (output
+    contribution 0).
+    """
+
+    hidden_size: int
+    expert: Callable[[], nn.Module]
+    num_experts: int = 1
+    k: int = 1
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    noisy_gate_policy: Any = None
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        b, t, c = x.shape
+        s = b * t
+        tokens = x.reshape(s, c)
+        # Router in fp32 — tiny matmul, and gate probabilities/cumsum
+        # positions are precision-sensitive.
+        logits = nn.Dense(self.num_experts, use_bias=False,
+                          dtype=jnp.float32, name="gate")(
+                              tokens.astype(jnp.float32))
+        noise_rng = None
+        if self.noisy_gate_policy == "Jitter" and not deterministic:
+            noise_rng = self.make_rng("dropout")
+        factor = self.capacity_factor if not deterministic \
+            else self.eval_capacity_factor
+        gate = top1gating if self.k == 1 else top2gating
+        l_aux, combine, dispatch, exp_counts = gate(
+            logits, capacity_factor=factor, min_capacity=self.min_capacity,
+            noise_rng=noise_rng)
+        # [S, E, C] x [S, C'] -> [E, cap, C']: the expert-parallel
+        # all-to-all, derived by GSPMD from the shardings.
+        dispatched = jnp.einsum(
+            "sec,sm->ecm", dispatch.astype(x.dtype), tokens)
+        expert_out = Experts(self.expert, self.num_experts,
+                             name="experts")(dispatched)
+        out = jnp.einsum("sec,ecm->sm", combine.astype(x.dtype),
+                         expert_out.astype(x.dtype))
+        return (out.reshape(b, t, -1), l_aux,
+                exp_counts)

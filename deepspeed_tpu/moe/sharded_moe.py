@@ -1,0 +1,123 @@
+"""Top-k gating + capacity-based dispatch for mixture-of-experts.
+
+TPU-native formulation of the DeepSpeed-MoE gating tier (the reference
+repo gained `deepspeed/moe/sharded_moe.py` with top1gating/top2gating in
+later releases; v0.3.10 predates it — like sequence parallelism, this is
+a beyond-reference capability, SURVEY §0). The math follows the GShard
+recipe: per-token softmax gate, capacity = ceil(k*S/E * factor), dispatch
+and combine expressed as EINSUMS over a [tokens, experts, capacity]
+tensor.
+
+Einsums are the whole point on TPU: with tokens sharded over 'data' and
+the expert dim sharded over 'model' (expert parallelism), XLA's SPMD
+partitioner lowers `dispatch @ tokens` / `combine @ expert_out` into the
+token all-to-alls automatically — no hand-written NCCL a2a plumbing like
+a CUDA implementation needs, and the collectives fuse into the
+surrounding program.
+
+Everything is fixed-shape (capacity pads/drops) so one compiled program
+serves every step — data-dependent token routing becomes dense masked
+arithmetic, which is what the MXU wants anyway.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _one_hot(x, n):
+    # Positions arrive as float cumsum products — cast for one_hot.
+    return jax.nn.one_hot(jnp.asarray(x).astype(jnp.int32), n,
+                          dtype=jnp.float32)
+
+
+def _capacity(tokens, num_experts, k, factor, min_capacity):
+    cap = int(max(min_capacity, -(-(k * tokens * factor) // num_experts)))
+    return min(cap, tokens)
+
+
+def _load_balance_loss(gates, mask1):
+    """GShard aux loss: E * <fraction of tokens per expert> . <mean gate
+    per expert>; minimized when routing is uniform."""
+    e = gates.shape[-1]
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    return e * jnp.sum(me * ce)
+
+
+def top1gating(logits, capacity_factor=1.0, min_capacity=4,
+               noise_rng=None, noise_eps=1e-2, used_token_mask=None):
+    """Switch-style top-1 gating.
+
+    Args:
+      logits: [S, E] fp32 router outputs.
+      noise_rng: optional PRNGKey — multiplicative jitter on the routing
+        logits (the 'Jitter' policy), training-time exploration.
+      used_token_mask: optional [S] 0/1 — padding tokens get no slot.
+    Returns: (l_aux, combine [S, E, C] fp32, dispatch [S, E, C] bool,
+      exp_counts [E]).
+    """
+    s, e = logits.shape
+    cap = _capacity(s, e, 1, capacity_factor, min_capacity)
+    route_logits = logits
+    if noise_rng is not None:
+        route_logits = logits * jax.random.uniform(
+            noise_rng, logits.shape, minval=1.0 - noise_eps,
+            maxval=1.0 + noise_eps)
+    gates = jax.nn.softmax(logits, axis=-1)               # [S, E]
+    expert1 = jnp.argmax(route_logits, axis=-1)           # [S]
+    mask1 = _one_hot(expert1, e)                          # [S, E]
+    if used_token_mask is not None:
+        mask1 = mask1 * used_token_mask[:, None]
+    l_aux = _load_balance_loss(gates, mask1)
+    # Position of each token in its expert's buffer; capacity overflow
+    # drops the token (its combine weights become 0 — residual carries it).
+    pos1 = jnp.cumsum(mask1, axis=0) - mask1              # [S, E]
+    mask1 = mask1 * (pos1 < cap)
+    exp_counts = jnp.sum(mask1, axis=0).astype(jnp.int32)
+    gate1 = jnp.sum(gates * mask1, axis=-1)               # [S]
+    pos_in_exp = jnp.sum(pos1 * mask1, axis=-1)           # [S]
+    dispatch = (mask1[:, :, None] *
+                _one_hot(pos_in_exp, cap)[:, None, :])    # [S, E, C]
+    combine = gate1[:, None, None] * dispatch
+    return l_aux, combine, dispatch.astype(bool), exp_counts
+
+
+def top2gating(logits, capacity_factor=1.0, min_capacity=4,
+               noise_rng=None, used_token_mask=None):
+    """GShard top-2 gating: second expert sampled from the residual
+    distribution, weights renormalized over the two winners."""
+    s, e = logits.shape
+    cap = _capacity(s, e, 2, capacity_factor, min_capacity)
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(expert1, e)
+    logits2 = jnp.where(mask1 > 0, -jnp.inf, logits)
+    if noise_rng is not None:
+        # GShard samples the 2nd expert proportionally to its gate.
+        logits2 = logits2 + jax.random.gumbel(noise_rng, logits2.shape)
+    expert2 = jnp.argmax(logits2, axis=-1)
+    mask2 = _one_hot(expert2, e)
+    if used_token_mask is not None:
+        mask1 = mask1 * used_token_mask[:, None]
+        mask2 = mask2 * used_token_mask[:, None]
+    l_aux = _load_balance_loss(gates, mask1)
+
+    pos1 = jnp.cumsum(mask1, axis=0) - mask1
+    # Expert-2 slots start after all expert-1 claims on the same expert.
+    pos2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0)
+    mask1 = mask1 * (pos1 < cap)
+    mask2 = mask2 * (pos2 < cap)
+    exp_counts = jnp.sum(mask1 + mask2, axis=0).astype(jnp.int32)
+
+    gate1 = jnp.sum(gates * mask1, axis=-1)
+    gate2 = jnp.sum(gates * mask2, axis=-1)
+    denom = jnp.maximum(gate1 + gate2, 1e-9)
+    gate1, gate2 = gate1 / denom, gate2 / denom
+
+    p1 = jnp.sum(pos1 * mask1, axis=-1)
+    p2 = jnp.sum(pos2 * mask2, axis=-1)
+    disp1 = mask1[:, :, None] * _one_hot(p1, cap)[:, None, :]
+    disp2 = mask2[:, :, None] * _one_hot(p2, cap)[:, None, :]
+    combine = gate1[:, None, None] * disp1 + gate2[:, None, None] * disp2
+    dispatch = (disp1 + disp2) > 0
+    return l_aux, combine, dispatch, exp_counts

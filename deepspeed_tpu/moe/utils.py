@@ -1,0 +1,26 @@
+"""MoE utilities: identify expert parameters (for per-group optimizer
+settings / checkpoint policies) — mirrors the DeepSpeed helper surface
+(later-release deepspeed/moe/utils.py is_moe_param /
+split_params_into_different_moe_groups_for_optimizer)."""
+
+import jax
+
+
+def is_moe_param_path(path) -> bool:
+    """True when a flax param tree path belongs to a stacked expert
+    (leading expert axis, sharded by expert parallelism)."""
+    names = [str(getattr(p, "key", getattr(p, "name", p))) for p in path]
+    return "experts" in names
+
+
+def split_moe_param_groups(params):
+    """Partition a param pytree into (dense_tree, expert_tree) with None
+    holes, so callers can apply different optimizer settings (the
+    reference splits torch param groups; functionally-partitioned pytrees
+    are the JAX equivalent)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    dense = [None if is_moe_param_path(p) else l for p, l in flat]
+    expert = [l if is_moe_param_path(p) else None for p, l in flat]
+    return (jax.tree_util.tree_unflatten(treedef, dense),
+            jax.tree_util.tree_unflatten(treedef, expert))

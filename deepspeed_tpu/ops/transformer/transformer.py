@@ -136,14 +136,16 @@ class DeepSpeedTransformerLayer(nn.Module):
         k = k.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
         v = v.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
         # stochastic_mode: the reference registers distinct faster,
-        # non-bit-reproducible training kernels for this flag
-        # (csrc/transformer/ds_transformer_cuda.cpp:1011-1028). The TPU
+        # non-bit-reproducible TRAINING kernels for this flag
+        # (csrc/transformer/ds_transformer_cuda.cpp:1011-1028); inference
+        # is unaffected there, so eval stays exact here too. The TPU
         # equivalent trade is precision-for-speed: an fp32 layer drops its
         # attention to the bf16 kernel fast path (model-dtype exp, fused
         # MXU row-sum/delta — ops/transformer/kernels/attention.py). bf16
         # layers already take that path, matching the reference's note
         # that stochastic mode mainly pays off in half precision.
-        stochastic_lowp = cfg.stochastic_mode and dt == jnp.float32
+        stochastic_lowp = cfg.stochastic_mode and dt == jnp.float32 \
+            and not deterministic
         if stochastic_lowp:
             q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))
 

@@ -114,6 +114,13 @@ def tree_sharding_over_axis(mesh: Mesh, tree, axis_name=DATA_AXIS):
 # to the user's Megatron mpu (SURVEY §0: TP is integrated, not implemented,
 # engine.py:514-525; these rules make it implemented).
 DEFAULT_TP_RULES = (
+    # Expert parallelism FIRST (first match wins): stacked-expert params
+    # (moe/layer.py Experts) carry a leading [num_experts] axis — shard it
+    # over 'model' and the MoE dispatch/combine einsums become token
+    # all-to-alls under GSPMD. Ordered before the Megatron rules because
+    # an expert module may itself be an attn/mlp whose inner path would
+    # otherwise match them and shard the wrong dim.
+    (r".*experts/.*", 0),
     (r".*(attn/c_attn|mlp/c_fc)/kernel$", 1),
     (r".*(attn/c_attn|mlp/c_fc)/bias$", 0),
     (r".*(attn|mlp)/c_proj/kernel$", 0),

@@ -804,36 +804,12 @@ class DeepSpeedEngine(object):
             return self._fwd_bwd_cache[key]
         grad_constraint = self._grad_constraint
 
-        module = self.module
         cast = self._cast_to_compute
-        apply_fn = module.apply if hasattr(module, "apply") else module
-        # Training must actually enable dropout: flax modules gate it on a
-        # `deterministic` kwarg defaulting True, so pass False when the model
-        # accepts it and the caller didn't choose explicitly.
-        accepts_deterministic = False
-        try:
-            import inspect
-            accepts_deterministic = "deterministic" in \
-                inspect.signature(type(module).__call__).parameters
-        except (TypeError, ValueError):
-            pass
+        apply_fn, accepts_deterministic = self._module_apply_setup()
+        make_loss = self._make_loss_fn(static_kwargs, train)
 
         def loss_and_grads(params, args, traced_kwargs, rng, scale):
-            def loss_fn(p):
-                cp = cast(p)
-                variables = {"params": cp}
-                call_kwargs = dict(static_kwargs)
-                call_kwargs.update(traced_kwargs)
-                if train:
-                    if accepts_deterministic:
-                        call_kwargs.setdefault("deterministic", False)
-                    out = apply_fn(variables, *args,
-                                   rngs={"dropout": rng}, **call_kwargs)
-                else:
-                    out = apply_fn(variables, *args, **call_kwargs)
-                loss = out[0] if isinstance(out, tuple) else out
-                return loss * scale, out
-
+            loss_fn = make_loss(args, traced_kwargs, rng, scale)
             (_, out), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             if grad_constraint is not None:
                 grads = jax.lax.with_sharding_constraint(grads, grad_constraint)
@@ -853,6 +829,49 @@ class DeepSpeedEngine(object):
             jitted = jax.jit(loss_and_grads)
         self._fwd_bwd_cache[key] = jitted
         return jitted
+
+    def _module_apply_setup(self):
+        """(apply_fn, accepts_deterministic) for the wrapped module —
+        shared by every fwd+bwd program builder. Training must actually
+        enable dropout: flax modules gate it on a `deterministic` kwarg
+        defaulting True, so builders pass False when the model accepts it
+        and the caller didn't choose explicitly."""
+        module = self.module
+        apply_fn = module.apply if hasattr(module, "apply") else module
+        accepts_deterministic = False
+        try:
+            import inspect
+            accepts_deterministic = "deterministic" in \
+                inspect.signature(type(module).__call__).parameters
+        except (TypeError, ValueError):
+            pass
+        return apply_fn, accepts_deterministic
+
+    def _make_loss_fn(self, static_kwargs, train):
+        """Factory for the scaled-loss closure shared by the plain and
+        grad-streaming fwd+bwd builders — ONE place owns the module
+        call / rng / deterministic conventions."""
+        cast = self._cast_to_compute
+        apply_fn, accepts_deterministic = self._module_apply_setup()
+
+        def make(args, traced_kwargs, rng, scale):
+            def loss_fn(p):
+                cp = cast(p)
+                call_kwargs = dict(static_kwargs)
+                call_kwargs.update(traced_kwargs)
+                if train:
+                    if accepts_deterministic:
+                        call_kwargs.setdefault("deterministic", False)
+                    out = apply_fn({"params": cp}, *args,
+                                   rngs={"dropout": rng}, **call_kwargs)
+                else:
+                    out = apply_fn({"params": cp}, *args, **call_kwargs)
+                loss = out[0] if isinstance(out, tuple) else out
+                return loss * scale, out
+
+            return loss_fn
+
+        return make
 
     def _stream_grads_active(self):
         """True when the offload tier should stream gradients to host
@@ -891,30 +910,11 @@ class DeepSpeedEngine(object):
             return self._fwd_bwd_cache[key]
         from jax.experimental import io_callback
 
-        module = self.module
-        cast = self._cast_to_compute
-        apply_fn = module.apply if hasattr(module, "apply") else module
-        accepts_deterministic = False
-        try:
-            import inspect
-            accepts_deterministic = "deterministic" in \
-                inspect.signature(type(module).__call__).parameters
-        except (TypeError, ValueError):
-            pass
+        make_loss = self._make_loss_fn(static_kwargs, train)
         sink = self._stream_sink
 
         def loss_and_stream(params, args, traced_kwargs, rng, scale):
-            def loss_fn(p):
-                cp = cast(p)
-                call_kwargs = dict(static_kwargs)
-                call_kwargs.update(traced_kwargs)
-                if train and accepts_deterministic:
-                    call_kwargs.setdefault("deterministic", False)
-                out = apply_fn({"params": cp}, *args,
-                               rngs={"dropout": rng}, **call_kwargs)
-                loss = out[0] if isinstance(out, tuple) else out
-                return loss * scale, out
-
+            loss_fn = make_loss(args, traced_kwargs, rng, scale)
             _, vjp_fn, out = jax.vjp(loss_fn, params, has_aux=True)
             (grads,) = vjp_fn(jnp.float32(1.0))
             sqs, toks = [], []

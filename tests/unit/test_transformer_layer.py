@@ -121,13 +121,18 @@ def test_stochastic_mode_fast_path_tracks_fp32():
     layer, cfg, params, x = make_layer(b, t, h, nh, True)
     s_layer, _, s_params, _ = make_layer(b, t, h, nh, True,
                                          stochastic_mode=True)
-    exact = layer.apply({"params": params}, x)
-    fast = s_layer.apply({"params": s_params}, x)
+    exact = layer.apply({"params": params}, x, deterministic=False)
+    fast = s_layer.apply({"params": s_params}, x, deterministic=False)
     assert fast.dtype == exact.dtype == jnp.float32
     np.testing.assert_allclose(np.asarray(fast), np.asarray(exact),
                                rtol=5e-2, atol=2e-2)
     # And it must not be bit-identical — the fast path really engaged.
     assert not np.array_equal(np.asarray(fast), np.asarray(exact))
+    # Inference is unaffected by the flag (reference: training-only
+    # kernels): eval outputs are bit-identical.
+    exact_eval = layer.apply({"params": params}, x)
+    fast_eval = s_layer.apply({"params": s_params}, x)
+    assert np.array_equal(np.asarray(fast_eval), np.asarray(exact_eval))
 
 
 def test_config_from_dict():
