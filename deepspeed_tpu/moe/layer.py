@@ -58,6 +58,12 @@ class MoE(nn.Module):
     [B, T, C]. Add ``l_aux`` (scaled by your aux coefficient) to the
     training loss; dropped-by-capacity tokens ride the residual (output
     contribution 0).
+
+    ``deterministic`` defaults to None = infer from the rng plumbing: the
+    engine threads a 'dropout' rng stream into training applies only, so
+    a nested MoE inside a model that does not forward the kwarg still
+    trains with ``capacity_factor`` (and Jitter noise) rather than
+    silently using the eval settings.
     """
 
     hidden_size: int
@@ -70,7 +76,9 @@ class MoE(nn.Module):
     noisy_gate_policy: Any = None
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=None):
+        if deterministic is None:
+            deterministic = not self.has_rng("dropout")
         b, t, c = x.shape
         s = b * t
         tokens = x.reshape(s, c)

@@ -133,9 +133,15 @@ def _tp_dim(path_str, leaf, rules, mp):
         return None
     shape = getattr(leaf, "shape", ())
     for pattern, dim in rules:
-        if re.match(pattern, path_str) and dim < len(shape) and \
-                shape[dim] % mp == 0:
-            return dim
+        if re.match(pattern, path_str):
+            # First PATTERN match decides; an indivisible dim means this
+            # leaf is replicated, not handed to a later rule — falling
+            # through would shard a semantically wrong dim (e.g. a
+            # stacked expert with num_experts % mp != 0 landing on the
+            # Megatron mlp rule and sharding its input dim).
+            if dim < len(shape) and shape[dim] % mp == 0:
+                return dim
+            return None
     return None
 
 

@@ -81,9 +81,7 @@ def compressed_allreduce(buffer, worker_error, server_error, axis_name):
 
     # --- phase 1: scatter sign chunks so worker r holds everyone's chunk r
     packed = pack_signs(sign).reshape(w, chunk // 8)
-    recv_signs = jax.lax.all_to_all(packed, axis_name, split_axis=0,
-                                    concat_axis=0, tiled=False)  # [w, chunk/8]
-    all_scales = jax.lax.all_gather(worker_scale, axis_name)      # [w]
+    recv_signs, all_scales = gather_tpu(axis_name, packed, worker_scale)
 
     # --- server-side average + re-compression for my chunk
     unpacked = jax.vmap(unpack_signs)(recv_signs)                 # [w, chunk]
@@ -95,8 +93,8 @@ def compressed_allreduce(buffer, worker_error, server_error, axis_name):
 
     # --- phase 2: all_gather compressed server chunks
     server_packed = pack_signs(server_sign)                       # [chunk/8]
-    gathered = jax.lax.all_gather(server_packed, axis_name)       # [w, chunk/8]
-    gathered_scales = jax.lax.all_gather(server_scale, axis_name) # [w]
+    gathered, gathered_scales = allgather_tpu(axis_name, server_packed,
+                                              server_scale)
     out = (jax.vmap(unpack_signs)(gathered) *
            gathered_scales[:, None]).reshape(-1)
     return out, new_worker_error, new_server_error
@@ -120,12 +118,33 @@ def quantize_error_feedback(buffer, error):
     return scale * sign, new_error
 
 
-# Reference-compatible aliases for the raw collective names
-# (custom_collectives.py:10-155); on TPU these are the XLA primitives.
-def gather_cuda(*a, **k):  # pragma: no cover - name parity shim
-    raise NotImplementedError(
-        "Raw MPI gathers do not exist on TPU; use compressed_allreduce "
-        "inside shard_map (jax.lax.all_to_all handles the exchange).")
+# Reference-compatible collective phases (custom_collectives.py:10-155:
+# gather_cuda/gather_host scatter packed sign chunks + scales so rank r
+# holds everyone's chunk r; allgather_cuda/allgather_host rebroadcast the
+# re-compressed server chunks). The reference needs four variants because
+# raw-MPI igather requires host buffers while cupy sometimes allows device
+# pointers; on TPU ONE implementation per phase serves both — an XLA
+# collective over the mesh axis, usable inside shard_map — and they are
+# the actual building blocks of compressed_allreduce above.
+
+def gather_tpu(axis_name, sign_list_packed, worker_scale):
+    """Phase-1 exchange: each worker offers [w, chunk/8] packed sign
+    chunks; returns (this worker's received [w, chunk/8] — chunk r from
+    every peer — and everyone's scales [w])."""
+    recv_signs = jax.lax.all_to_all(sign_list_packed, axis_name,
+                                    split_axis=0, concat_axis=0,
+                                    tiled=False)
+    all_scales = jax.lax.all_gather(worker_scale, axis_name)
+    return recv_signs, all_scales
 
 
-gather_host = allgather_cuda = allgather_host = gather_cuda
+def allgather_tpu(axis_name, server_sign_packed, server_scale):
+    """Phase-2 exchange: rebroadcast each worker's re-compressed server
+    chunk [chunk/8] + scale; returns ([w, chunk/8], [w])."""
+    gathered = jax.lax.all_gather(server_sign_packed, axis_name)
+    gathered_scales = jax.lax.all_gather(server_scale, axis_name)
+    return gathered, gathered_scales
+
+
+gather_cuda = gather_host = gather_tpu
+allgather_cuda = allgather_host = allgather_tpu

@@ -805,8 +805,9 @@ class DeepSpeedEngine(object):
         grad_constraint = self._grad_constraint
 
         cast = self._cast_to_compute
-        apply_fn, accepts_deterministic = self._module_apply_setup()
-        make_loss = self._make_loss_fn(static_kwargs, train)
+        setup = self._module_apply_setup()
+        apply_fn, accepts_deterministic = setup
+        make_loss = self._make_loss_fn(static_kwargs, train, setup=setup)
 
         def loss_and_grads(params, args, traced_kwargs, rng, scale):
             loss_fn = make_loss(args, traced_kwargs, rng, scale)
@@ -847,12 +848,13 @@ class DeepSpeedEngine(object):
             pass
         return apply_fn, accepts_deterministic
 
-    def _make_loss_fn(self, static_kwargs, train):
+    def _make_loss_fn(self, static_kwargs, train, setup=None):
         """Factory for the scaled-loss closure shared by the plain and
         grad-streaming fwd+bwd builders — ONE place owns the module
-        call / rng / deterministic conventions."""
+        call / rng / deterministic conventions. ``setup`` lets a caller
+        that already ran _module_apply_setup pass it through."""
         cast = self._cast_to_compute
-        apply_fn, accepts_deterministic = self._module_apply_setup()
+        apply_fn, accepts_deterministic = setup or self._module_apply_setup()
 
         def make(args, traced_kwargs, rng, scale):
             def loss_fn(p):

@@ -35,7 +35,7 @@ RUNGS = [
 ]
 
 
-def probe_rung(label, n_embd, n_layer, seq):
+def probe_rung(label, n_embd, n_layer, seq, stream=True):
     import jax
 
     import deepspeed_tpu as deepspeed
@@ -47,15 +47,22 @@ def probe_rung(label, n_embd, n_layer, seq):
     cfg = GPT2Config(n_embd=n_embd, n_layer=n_layer, n_head=heads,
                      dropout=0.0, remat=True)
     params = cfg.num_params()
-    print("probe {}: C={} L={} => {:.2f}B params".format(
-        label, n_embd, n_layer, params / 1e9), file=sys.stderr)
+    print("probe {}{}: C={} L={} => {:.2f}B params".format(
+        label, "" if stream else " (no-stream retry)", n_embd, n_layer,
+        params / 1e9), file=sys.stderr)
     engine, _, _, _ = deepspeed.initialize(
         model=GPT2LMHeadModel(cfg),
         config_params={
             "train_batch_size": 1,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
             "bf16": {"enabled": True},
-            "zero_optimization": {"stage": 2, "cpu_offload": True},
+            # stream_gradients: grads leave via io_callback during the
+            # backward with param buffers donated, so the device holds
+            # ~2 bytes/param instead of ~4 — the capacity headline rides
+            # on it. main() retries a failed rung without streaming to
+            # separate streaming bugs from genuine OOM.
+            "zero_optimization": {"stage": 2, "cpu_offload": True,
+                                  "stream_gradients": stream},
         })
     ids = np.random.RandomState(0).randint(0, cfg.vocab_size, size=(1, seq))
     t0 = time.time()
@@ -69,6 +76,7 @@ def probe_rung(label, n_embd, n_layer, seq):
     result = {
         "rung": label,
         "params": params,
+        "stream_gradients": stream,
         "step_seconds": round(step_s, 1),
         "loss": loss,
         "hbm_peak_bytes": stats.get("peak_bytes_in_use"),
@@ -89,11 +97,17 @@ def main():
     for label, c, l in RUNGS[args.start:]:
         try:
             r = probe_rung(label, c, l, args.seq)
-        except Exception as e:  # OOM (device or host) ends the walk
-            print(json.dumps({"rung": label, "failed": str(e)[-500:]}))
-            print("probe {}: FAILED — ceiling is the previous rung"
-                  .format(label), file=sys.stderr)
-            return 0
+        except Exception as stream_err:
+            # Retry without streaming: a streaming-path bug must not be
+            # misreported as the capacity ceiling.
+            try:
+                r = probe_rung(label, c, l, args.seq, stream=False)
+                r["stream_error"] = str(stream_err)[-300:]
+            except Exception as e:  # genuine OOM ends the walk
+                print(json.dumps({"rung": label, "failed": str(e)[-500:]}))
+                print("probe {}: FAILED — ceiling is the previous rung"
+                      .format(label), file=sys.stderr)
+                return 0
         print(json.dumps(r))
         sys.stdout.flush()
     return 0

@@ -192,6 +192,25 @@ def test_moe_model_trains_with_engine(eight_devices):
     assert np.isfinite(losses).all()
 
 
+def test_moe_infers_training_from_rng_stream():
+    """A nested MoE that never receives the deterministic kwarg must still
+    use the TRAINING capacity factor when a dropout rng is threaded (the
+    engine does this) — eval settings only apply without one."""
+    layer = _moe_layer(num_experts=4, k=1, capacity_factor=0.25,
+                       eval_capacity_factor=4.0, min_capacity=1)
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 16, 16), jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+    # Training apply (dropout rng present): tiny capacity -> few slots.
+    _, _, train_counts = layer.apply(
+        {"params": params}, x, rngs={"dropout": jax.random.PRNGKey(1)})
+    # Eval apply (no rng): ample capacity.
+    _, _, eval_counts = layer.apply({"params": params}, x)
+    s, e = 32, 4
+    cap_train = max(1, -(-s // (4 * e)))  # ceil(S*0.25/E)
+    assert int(np.asarray(train_counts).max()) <= cap_train
+    assert int(np.asarray(eval_counts).max()) > cap_train
+
+
 def test_expert_rule_wins_over_megatron_rules():
     """A stacked expert whose INNER path matches a Megatron TP rule (the
     canonical case: the expert is the model's own mlp) must still shard
