@@ -182,8 +182,13 @@ def _dp_minus_delta(do, v_blk, delta):
         do_ext = jnp.concatenate([do.astype(dtype), -d_hi, -d_lo], axis=1)
         ones = jnp.ones((v_blk.shape[0], 2), dtype)
         v_ext = jnp.concatenate([v_blk, ones], axis=1)
-        return jax.lax.dot_general(do_ext, v_ext, (((1,), (1,)), ((), ())),
-                                   preferred_element_type=dtype)
+        # Mosaic requires the MXU accumulator to be 32-bit (a bf16
+        # preferred_element_type fails verification), so accumulate in
+        # fp32 and cast on emit — same rounding contract: the cast error
+        # is relative to the small difference, not to delta.
+        out = jax.lax.dot_general(do_ext, v_ext, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return out.astype(dtype)
     if _is_lowp(dtype):  # fp16: unfused fp32 subtract (overflow-safe)
         dp = jax.lax.dot_general(do.astype(dtype), v_blk,
                                  (((1,), (1,)), ((), ())),

@@ -1,0 +1,149 @@
+"""TPU measurement battery with a wedge-surviving watcher.
+
+The tunneled dev TPU's relay wedges when a client dies mid-grant (the
+grant is never released and every later backend init blocks forever) and
+recovers when the stale grant expires — minutes to hours later. This
+script is the round's evidence collector: it re-probes with backoff until
+the chip answers, then runs every measurement stage in priority order,
+each in its OWN subprocess with its own timeout so a mid-stage wedge
+costs one stage, not the battery. Artifacts land in ``.tpu_runs/``:
+
+  .tpu_runs/<stage>.out / <stage>.err / battery.log
+
+Stage order is the evidence priority from VERDICT.md round 2: the
+headline bench first (the single most important artifact), then the
+kernel microbench, the sweep, the 1.5B offload run, and the capacity
+probe (longest) last.
+
+Usage: python tests/perf/tpu_battery.py [--budget SECS] [--stages a,b,..]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+RUNS = os.path.join(REPO, ".tpu_runs")
+
+SMOKE = """
+import jax, jax.numpy as jnp
+from deepspeed_tpu.ops.transformer.kernels.attention import (
+    flash_attention, mha_reference)
+assert jax.default_backend() == "tpu", jax.default_backend()
+ks = jax.random.split(jax.random.PRNGKey(0), 4)
+B, H, T, D = 2, 4, 1024, 64
+for dtype, tol in ((jnp.bfloat16, 5e-2), (jnp.float32, 2e-3)):
+    q, k, v, do = (jax.random.normal(kk, (B, H, T, D), dtype) for kk in ks)
+    def loss(f):
+        return lambda a, b, c: (f(a, b, c, causal=True).astype(
+            jnp.float32) * do.astype(jnp.float32)).sum()
+    o = flash_attention(q, k, v, causal=True)
+    r = mha_reference(q, k, v, causal=True)
+    err = float(jnp.abs(o.astype(jnp.float32) - r.astype(jnp.float32)).max())
+    assert err < tol, ("fwd", dtype, err)
+    gf = jax.jit(jax.grad(loss(flash_attention), argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss(mha_reference), argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        ga = jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)).max()
+        scale = max(1.0, float(jnp.abs(b.astype(jnp.float32)).max()))
+        assert float(ga) / scale < tol, ("d" + name, dtype, float(ga))
+    print("parity ok", jnp.dtype(dtype).name)
+print("SMOKE PASS")
+"""
+
+# (name, argv-or-inline, timeout_s, env_extra)
+STAGES = [
+    ("smoke", ["-c", SMOKE], 1200, {}),
+    ("headline", ["bench.py"], 2400,
+     {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1"}),
+    ("attn", ["tests/perf/attention_bench.py", "--dense"], 2400, {}),
+    ("attn2048", ["tests/perf/attention_bench.py", "--seq", "2048",
+                  "--batch", "4", "--dense"], 2400, {}),
+    ("sweep", ["bench.py", "--sweep"], 4200,
+     {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1"}),
+    ("xl_compute", ["bench.py", "--xl-compute"], 2400,
+     {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1"}),
+    ("xl", ["bench.py", "--xl"], 4200,
+     {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1"}),
+    ("capacity", ["tests/perf/capacity_probe.py"], 10800, {}),
+]
+
+
+def log(msg):
+    line = "[{}] {}".format(time.strftime("%H:%M:%S"), msg)
+    print(line, file=sys.stderr)
+    with open(os.path.join(RUNS, "battery.log"), "a") as f:
+        f.write(line + "\n")
+
+
+def probe(timeout=180):
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout, capture_output=True, text=True, cwd=REPO)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def wait_for_chip(deadline):
+    backoff = 30
+    attempt = 0
+    while time.time() < deadline:
+        attempt += 1
+        if probe():
+            log("probe ok (attempt {})".format(attempt))
+            return True
+        log("probe {} failed; retry in {}s".format(attempt, backoff))
+        time.sleep(min(backoff, max(0, deadline - time.time())))
+        backoff = min(int(backoff * 1.5), 300)
+    return False
+
+
+def run_stage(name, argv, timeout, env_extra):
+    out = os.path.join(RUNS, name + ".out")
+    err = os.path.join(RUNS, name + ".err")
+    env = dict(os.environ, **env_extra)
+    log("stage {} starting (timeout {}s)".format(name, timeout))
+    t0 = time.time()
+    try:
+        with open(out, "w") as fo, open(err, "w") as fe:
+            r = subprocess.run([sys.executable] + argv, timeout=timeout,
+                               stdout=fo, stderr=fe, cwd=REPO, env=env)
+        rc = r.returncode
+    except subprocess.TimeoutExpired:
+        rc = -9
+    log("stage {} done rc={} ({:.0f}s)".format(name, rc, time.time() - t0))
+    return rc == 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=float, default=6 * 3600)
+    ap.add_argument("--stages", default=",".join(s[0] for s in STAGES))
+    args = ap.parse_args()
+    os.makedirs(RUNS, exist_ok=True)
+    want = [s.strip() for s in args.stages.split(",") if s.strip()]
+    deadline = time.time() + args.budget
+    results = {}
+    for name, argv, timeout, env_extra in STAGES:
+        if name not in want:
+            continue
+        if not wait_for_chip(deadline):
+            log("budget exhausted waiting for chip; stopping")
+            break
+        results[name] = run_stage(
+            name, argv, min(timeout, max(60, deadline - time.time())),
+            env_extra)
+    with open(os.path.join(RUNS, "battery_results.json"), "w") as f:
+        json.dump(results, f, indent=1)
+    log("battery complete: {}".format(results))
+    return 0 if results and all(results.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
