@@ -1,0 +1,83 @@
+"""Mixture-of-experts layer with expert parallelism over the mesh.
+
+docs/tutorials/mixture-of-experts.md end to end:
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python examples/moe_layer.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.moe import MoE
+
+
+class ExpertMLP(nn.Module):
+    hidden: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.gelu(nn.Dense(4 * self.hidden)(x))
+        return nn.Dense(self.hidden)(h)
+
+
+class MoEClassifier(nn.Module):
+    hidden: int = 32
+    classes: int = 8
+    num_experts: int = 4
+
+    @nn.compact
+    def __call__(self, x, labels=None, deterministic=True):
+        h = nn.Dense(self.hidden)(x)[:, None, :]       # [B, T=1, C]
+        out, l_aux, _ = MoE(hidden_size=self.hidden,
+                            expert=lambda: ExpertMLP(self.hidden),
+                            num_experts=self.num_experts, k=2,
+                            noisy_gate_policy="Jitter")(
+                                h, deterministic=deterministic)
+        logits = nn.Dense(self.classes)(out[:, 0])
+        if labels is None:
+            return logits
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        xent = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+        return xent + 0.01 * l_aux
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=15)
+    args = ap.parse_args()
+
+    import deepspeed_tpu as deepspeed
+    model = MoEClassifier()
+    engine, _, _, _ = deepspeed.initialize(
+        model=model,
+        config_params={
+            "train_batch_size": 32,
+            "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+        })
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 16).astype(np.float32)
+    y = rng.randint(0, 8, size=(32,))
+    for step in range(args.steps):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        if step % 5 == 0 or step == args.steps - 1:
+            print("step {:3d}  loss {:.4f}".format(step, float(loss)))
+
+
+if __name__ == "__main__":
+    main()

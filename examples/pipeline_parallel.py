@@ -1,0 +1,72 @@
+"""Two-stage pipeline parallelism with LayerSpec deferral.
+
+docs/tutorials/pipeline.md end to end on the virtual mesh:
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python examples/pipeline_parallel.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.pipe import LayerSpec, PipelineModule
+
+
+class Affine(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.tanh(nn.Dense(self.features)(x))
+
+
+def xent(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    hidden, classes, batch = 32, 8, 16
+    net = PipelineModule(
+        layers=[LayerSpec(Affine, hidden) for _ in range(4)] +
+               [LayerSpec(nn.Dense, classes)],
+        num_stages=2,
+        loss_fn=xent,
+        partition_method="parameters")
+
+    engine, _, _, _ = deepspeed.initialize(
+        model=net,
+        config_params={
+            "train_batch_size": batch,
+            "train_micro_batch_size_per_gpu": batch // 4,  # 4 microbatches
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        })
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, hidden).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32) % classes
+    for step in range(args.steps):
+        loss = engine.train_batch(batch=(x, y))
+        if step % 3 == 0 or step == args.steps - 1:
+            print("step {:3d}  loss {:.4f}".format(step, float(loss)))
+
+
+if __name__ == "__main__":
+    main()

@@ -128,6 +128,11 @@ def main():
     args = ap.parse_args()
     os.makedirs(RUNS, exist_ok=True)
     want = [s.strip() for s in args.stages.split(",") if s.strip()]
+    known = {s[0] for s in STAGES}
+    unknown = sorted(set(want) - known)
+    if unknown:
+        ap.error("unknown stage(s) {} (known: {})".format(
+            unknown, sorted(known)))
     deadline = time.time() + args.budget
     results = {}
     for name, argv, timeout, env_extra in STAGES:
