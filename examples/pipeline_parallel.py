@@ -55,7 +55,10 @@ def main():
         model=net,
         config_params={
             "train_batch_size": batch,
-            "train_micro_batch_size_per_gpu": batch // 4,  # 4 microbatches
+            # 2 stages on the 8-device mesh -> dp=4 per stage; micro
+            # batch 1 gives 16/(1*4) = 4 micro-batches through the 1F1B
+            # schedule.
+            "train_micro_batch_size_per_gpu": 1,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
         })
 
