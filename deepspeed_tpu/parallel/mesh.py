@@ -42,7 +42,8 @@ def build_mesh(num_dp: Optional[int] = None,
     The 'seq' axis carries sequence (context) parallelism — beyond the
     reference, which has none in v0.3.10 (SURVEY §0).
     """
-    devices = devices if devices is not None else jax.devices()
+    explicit = devices is not None
+    devices = devices if explicit else jax.devices()
     n = len(devices)
     if num_dp is None:
         assert n % (num_mp * num_pp * num_sp) == 0, \
@@ -52,8 +53,50 @@ def build_mesh(num_dp: Optional[int] = None,
     assert num_dp * num_mp * num_pp * num_sp == n, \
         "mesh {}x{}x{}x{} != {} devices".format(num_pp, num_dp, num_sp,
                                                 num_mp, n)
-    dev_array = np.asarray(devices).reshape(num_pp, num_dp, num_sp, num_mp)
+    shape = (num_pp, num_dp, num_sp, num_mp)
+    dev_array = _arrange(devices, shape, explicit)
     return Mesh(dev_array, (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+
+
+def _arrange(devices, shape, explicit):
+    """Physical device layout for the logical mesh shape.
+
+    On real multi-chip TPU, a flat ``jax.devices()`` reshape gives the
+    innermost ('model') axis no ICI-adjacency guarantee — tensor-parallel
+    collectives would hop the torus arbitrarily. Delegate to
+    ``jax.experimental.mesh_utils``, which maps logical axes onto the
+    physical topology (innermost axes onto nearest-neighbor rings):
+
+    - one ICI slice (single- or multi-host — a pod slice is one ICI
+      domain regardless of process count): ``create_device_mesh``;
+    - multiple slices (``slice_index`` differs, i.e. DCN between them):
+      the scaling-book split — the data axis carries the cross-slice
+      (DCN) factor, everything else ('pipe','seq','model' and the
+      per-slice remainder of 'data') stays inside each slice's ICI
+      domain via ``create_hybrid_device_mesh``.
+
+    An EXPLICIT device list keeps the caller's order (tests and
+    submesh-pinning callers depend on it), and non-TPU platforms keep the
+    plain reshape (virtual CPU meshes have no topology; a reorder would
+    only shuffle test determinism)."""
+    num_pp, num_dp, num_sp, num_mp = shape
+    if explicit or not devices or devices[0].platform != "tpu" or \
+            len(devices) == 1:
+        return np.asarray(devices).reshape(shape)
+    try:
+        from jax.experimental import mesh_utils
+
+        slices = len({getattr(d, "slice_index", 0) for d in devices})
+        if slices > 1 and num_dp % slices == 0:
+            return mesh_utils.create_hybrid_device_mesh(
+                (num_pp, num_dp // slices, num_sp, num_mp),
+                (1, slices, 1, 1), devices=devices)
+        return mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception as e:  # topology solver unavailable/unhappy: still run
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning("mesh_utils arrangement failed (%s); falling back "
+                       "to flat device order", e)
+        return np.asarray(devices).reshape(shape)
 
 
 def default_mesh() -> Mesh:

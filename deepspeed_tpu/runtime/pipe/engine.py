@@ -60,11 +60,13 @@ class PipelineEngine(DeepSpeedEngine):
         # (reference PipelineParallelGrid semantics, pipe/topology.py:246-455).
         if kwargs.get("mesh") is None:
             from deepspeed_tpu.parallel.mesh import build_mesh
-            devices = jax.devices()
-            pp = model.num_stages if len(devices) % model.num_stages == 0 \
-                and len(devices) >= model.num_stages else 1
-            kwargs["mesh"] = build_mesh(num_dp=len(devices) // pp, num_mp=1,
-                                        num_pp=pp, devices=devices)
+            n_dev = jax.device_count()
+            pp = model.num_stages if n_dev % model.num_stages == 0 \
+                and n_dev >= model.num_stages else 1
+            # devices deliberately NOT passed: build_mesh then applies the
+            # topology-aware (ICI/DCN) arrangement on real TPU.
+            kwargs["mesh"] = build_mesh(num_dp=n_dev // pp, num_mp=1,
+                                        num_pp=pp)
         _mesh = kwargs["mesh"]
         _n = _mesh.devices.size
         _mp = _mesh.shape.get(mesh_lib.MODEL_AXIS, 1)

@@ -98,3 +98,72 @@ def test_active_sp_axis_outside_shard_map():
 
     assert active_sp_axis(None) is None
     assert active_sp_axis("seq") is None  # not bound outside shard_map
+
+
+def test_arrange_topology_paths(monkeypatch):
+    """_arrange: explicit lists and CPU devices keep caller/flat order;
+    fake-TPU devices route through mesh_utils (hybrid when multi-process,
+    ICI-aware otherwise) and fall back to flat order if the solver
+    throws."""
+    import jax.experimental
+
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+
+    class FakeDev:
+        platform = "tpu"
+
+        def __init__(self, i, slice_index=0):
+            self.id = i
+            self.slice_index = slice_index
+
+        def __repr__(self):
+            return "d{}".format(self.id)
+
+    cpus = jax.devices()[:8]
+    shape = (1, 2, 1, 4)
+
+    # Explicit list => caller order, even for "tpu" devices.
+    tpus = [FakeDev(i) for i in range(8)]
+    arr = mesh_lib._arrange(tpus, shape, explicit=True)
+    assert [d.id for d in arr.reshape(-1)] == list(range(8))
+    # CPU platform => flat order.
+    arr = mesh_lib._arrange(cpus, shape, explicit=False)
+    assert list(arr.reshape(-1)) == list(cpus)
+
+    calls = {}
+
+    class FakeMeshUtils:
+        @staticmethod
+        def create_device_mesh(shape_, devices=None):
+            calls["single"] = shape_
+            return np.asarray(devices).reshape(shape_)
+
+        @staticmethod
+        def create_hybrid_device_mesh(ici, dcn, devices=None):
+            calls["hybrid"] = (ici, dcn)
+            return np.asarray(devices).reshape(
+                tuple(i * d for i, d in zip(ici, dcn)))
+
+    monkeypatch.setattr(jax.experimental, "mesh_utils", FakeMeshUtils)
+
+    arr = mesh_lib._arrange(tpus, shape, explicit=False)
+    assert calls["single"] == shape and arr.shape == shape
+
+    # One ICI slice spanning multiple hosts must STILL take the
+    # single-slice path (a pod slice is one ICI domain); only genuinely
+    # multi-slice (DCN-connected) device sets go hybrid.
+    two_slice = [FakeDev(i, slice_index=i // 4) for i in range(8)]
+    calls.clear()
+    arr = mesh_lib._arrange(two_slice, shape, explicit=False)
+    # dp=2 splits across 2 slices: dcn carries data, ICI the rest.
+    assert calls == {"hybrid": ((1, 1, 1, 4), (1, 2, 1, 1))}
+    assert arr.shape == shape
+
+    class Broken:
+        @staticmethod
+        def create_device_mesh(shape_, devices=None):
+            raise RuntimeError("no topology")
+
+    monkeypatch.setattr(jax.experimental, "mesh_utils", Broken)
+    arr = mesh_lib._arrange(tpus, shape, explicit=False)
+    assert [d.id for d in arr.reshape(-1)] == list(range(8))
