@@ -1,2 +1,3 @@
+from deepspeed_tpu.models.generation import generate
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel, create_model
 from deepspeed_tpu.models.simple import LinearStack, SimpleModel

@@ -1,0 +1,173 @@
+"""Autoregressive generation for GPT2LMHeadModel — KV-cache decode.
+
+Beyond the v0.3.10 reference (which has no generation API; its inference
+surface is pipeline eval_batch). Decode-time compute has a different
+shape than training — one token's [B, 1, C] activations against a
+[B, H, T, D] cache — so rather than threading flag-switched branches
+through the training modules, this is a separate pure-functional decode
+program over the SAME parameter tree the engine trains (the flax param
+names are the contract; `tests/unit/test_generation.py` pins step-logit
+parity against the training forward). TPU-first mechanics:
+
+- static shapes end to end: the cache is pre-allocated at
+  ``prompt_len + max_new_tokens``; per-step masks come from iota vs a
+  traced position scalar, never from dynamic slicing on token count;
+- the decode loop is ONE ``lax.scan`` inside ONE jit — no per-token
+  dispatch, no host round-trips; sampling (greedy / temperature / top-k)
+  runs on-device from a threaded threefry key;
+- prefill is a single batched pass over the prompt (MXU-sized GEMMs),
+  writing the cache for all prompt positions at once;
+- early EOS freezes finished rows (they keep emitting ``eos_token_id``)
+  without leaving the scan — the fixed trip count keeps the program
+  static; trim host-side.
+"""
+
+import collections
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Hashable shape/dtype subset of GPT2Config (the dataclass itself is
+# unhashable, and jit's static args must hash).
+_GenCfg = collections.namedtuple(
+    "_GenCfg", "n_layer n_head n_embd n_positions dtype")
+
+
+def init_cache(cfg, batch, max_len, dtype=None):
+    """Zeroed [layers, B, heads, max_len, head_dim] k/v cache + position."""
+    dtype = dtype or cfg.dtype
+    hd = cfg.n_embd // cfg.n_head
+    shape = (cfg.n_layer, batch, cfg.n_head, max_len, hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def _ln(x, p, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _dense(x, p):
+    return (x @ p["kernel"].astype(x.dtype) +
+            p["bias"].astype(x.dtype))
+
+
+def _forward(params, cfg, ids, cache, last_only=False):
+    """ids [B, S] starting at cache['pos']; returns (logits [B, S, V] fp32,
+    updated cache). S=prompt_len for prefill, S=1 inside the decode scan.
+    ``last_only`` evaluates the LM head on the final position only (the
+    prefill path — sampling reads just that row, and a [B, Tp, vocab]
+    fp32 buffer would otherwise dominate prefill memory)."""
+    B, S = ids.shape
+    nh, hd = cfg.n_head, cfg.n_embd // cfg.n_head
+    pos0 = cache["pos"]
+    max_len = cache["k"].shape[3]
+
+    wte = params["wte"].astype(cfg.dtype)
+    pe = jax.lax.dynamic_slice_in_dim(
+        params["wpe"].astype(cfg.dtype), pos0, S, axis=0)
+    x = wte[ids] + pe[None]
+
+    q_pos = pos0 + jnp.arange(S)                       # [S]
+    k_pos = jnp.arange(max_len)                        # [max_len]
+    # Causal vs the GLOBAL position: key j visible to query i iff j <= i.
+    # Cache slots past the current frontier are excluded by the same
+    # comparison (they hold zeros and positions > q_pos).
+    mask = k_pos[None, :] <= q_pos[:, None]            # [S, max_len]
+    neg = jnp.finfo(jnp.float32).min
+    k_cache, v_cache = cache["k"], cache["v"]
+
+    for i in range(cfg.n_layer):
+        blk = params["h_{}".format(i)]
+        h = _ln(x, blk["ln_1"])
+        qkv = _dense(h, blk["attn"]["c_attn"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k[None], (i, 0, 0, pos0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v[None], (i, 0, 0, pos0, 0))
+        att = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache[i]).astype(
+            jnp.float32) / jnp.sqrt(hd)
+        att = jnp.where(mask[None, None], att, neg)
+        att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
+        y = jnp.einsum("bhqk,bhkd->bhqd", att, v_cache[i])
+        y = y.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_embd)
+        x = x + _dense(y, blk["attn"]["c_proj"])
+        h = _ln(x, blk["ln_2"])
+        h = _dense(h, blk["mlp"]["c_fc"])
+        h = jax.nn.gelu(h, approximate=True)
+        x = x + _dense(h, blk["mlp"]["c_proj"])
+
+    if last_only:
+        x = x[:, -1:]
+    x = _ln(x, params["ln_f"])
+    logits = jnp.einsum("bsc,vc->bsv", x.astype(jnp.float32),
+                        params["wte"].astype(jnp.float32))
+    return logits, {"k": k_cache, "v": v_cache, "pos": pos0 + S}
+
+
+def _sample(logits, rng, temperature, top_k):
+    """[B, V] fp32 logits -> [B] token ids."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, jnp.finfo(jnp.float32).min, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 3, 4, 5, 7))
+def _generate_jit(params, cfg, prompt_ids, max_new_tokens, temperature,
+                  top_k, rng, eos_token_id):
+    B, Tp = prompt_ids.shape
+    cache = init_cache(cfg, B, Tp + max_new_tokens)
+    logits, cache = _forward(params, cfg, prompt_ids, cache,
+                             last_only=True)                   # prefill
+    rng0, rng = jax.random.split(rng)
+    first = _sample(logits[:, -1], rng0, temperature, top_k)
+    done = jnp.zeros((B,), bool) if eos_token_id is not None else None
+
+    def step(carry, rng_t):
+        tok, cache, done = carry
+        logits, cache = _forward(params, cfg, tok[:, None], cache)
+        nxt = _sample(logits[:, 0], rng_t, temperature, top_k)
+        if done is not None:
+            done = done | (tok == eos_token_id)
+            nxt = jnp.where(done, eos_token_id, nxt)
+        return (nxt, cache, done), nxt
+
+    (_, _, _), rest = jax.lax.scan(
+        step, (first, cache, done),
+        jax.random.split(rng, max_new_tokens - 1))
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def generate(model, params, prompt_ids, max_new_tokens, temperature=1.0,
+             top_k=None, rng=None, eos_token_id=None):
+    """Sample ``max_new_tokens`` continuations of ``prompt_ids`` [B, Tp].
+
+    ``model`` is the GPT2LMHeadModel (its config drives shapes/dtype);
+    ``params`` the trained tree (``engine.params`` or a checkpoint).
+    ``temperature=0`` is greedy (rng unused); otherwise pass a PRNG key.
+    Returns [B, max_new_tokens] int32. Rows that emit ``eos_token_id``
+    keep repeating it (fixed-length output; trim host-side).
+    """
+    cfg = getattr(model, "config", model)
+    cfg = _GenCfg(cfg.n_layer, cfg.n_head, cfg.n_embd, cfg.n_positions,
+                  cfg.dtype)
+    assert max_new_tokens >= 1
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    assert prompt_ids.shape[1] + max_new_tokens <= cfg.n_positions, \
+        "prompt + new tokens exceed n_positions={}".format(cfg.n_positions)
+    return _generate_jit(params, cfg, prompt_ids, int(max_new_tokens),
+                         float(temperature), top_k, rng, eos_token_id)
