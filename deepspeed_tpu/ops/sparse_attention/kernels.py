@@ -489,16 +489,21 @@ def block_sparse_attention(q, k, v, layout, block, scale=None, causal=False,
 def block_sparse_attention_reference(q, k, v, layout, block, scale=None,
                                      causal=False, key_padding_mask=None,
                                      key_padding_mask_mode='add',
-                                     attn_bias=None, attn_bias_mode='add'):
+                                     attn_bias=None, attn_bias_mode='add',
+                                     precision=None):
     """Dense jnp ground truth: expand the block layout to an elementwise mask
-    and run ordinary softmax attention. Used by parity tests."""
+    and run ordinary softmax attention. Used by parity tests.
+
+    precision: forwarded to the einsums; on-TPU oracle callers must pass
+    'highest' (DEFAULT rounds the fp32 operands to bf16 on the MXU, making
+    the ground truth less accurate than the kernel under test)."""
     b, h, t, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     layout = np.asarray(layout)
     dense = np.kron(layout, np.ones((block, block)))[:, :t, :t]  # [H, T, T]
     s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+                   k.astype(jnp.float32), precision=precision) * scale
     if key_padding_mask is not None:
         kpm = key_padding_mask.astype(jnp.float32)[:, None, None, :]
         s = s * kpm if key_padding_mask_mode == 'mul' else s + kpm
@@ -515,4 +520,5 @@ def block_sparse_attention_reference(q, k, v, layout, block, scale=None,
     if causal:
         pass
     p = jnp.where(row_any, p, 0.0)
-    return jnp.einsum('bhqk,bhkd->bhqd', p, v.astype(jnp.float32)).astype(q.dtype)
+    return jnp.einsum('bhqk,bhkd->bhqd', p, v.astype(jnp.float32),
+                      precision=precision).astype(q.dtype)

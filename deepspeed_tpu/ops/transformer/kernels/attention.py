@@ -78,15 +78,24 @@ def _interpret():
 # ---------------------------------------------------------------------------
 
 def mha_reference(q, k, v, mask=None, causal=False, scale=None,
-                  return_lse=False):
+                  return_lse=False, precision=None):
     """q,k,v: [B, H, T, D]; mask: additive [B, T_kv] (broadcast over heads
     and query rows, the BERT padding-mask shape). With return_lse, also
     returns the per-row logsumexp [B, H, T, 1] fp32 (the ragged fallback
-    of flash_attention_with_lse shares this single dense implementation)."""
+    of flash_attention_with_lse shares this single dense implementation).
+
+    precision: forwarded to the two einsums. Production fallback callers
+    leave the DEFAULT (on the TPU MXU that is a single bf16-input pass —
+    fast, and consistent with the recompute in ring attention's dense
+    backward, so fwd/bwd rounding cancels). Parity/oracle callers that
+    compare the KERNEL against this function on real TPU hardware must
+    pass 'highest': at DEFAULT the oracle's fp32 (or fp16-origin)
+    operands are rounded to bf16, making the ground truth LESS accurate
+    than the kernel under test."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+                   k.astype(jnp.float32), precision=precision) * scale
     if mask is not None:
         s = s + mask[:, None, None, :].astype(jnp.float32)
     if causal:
@@ -100,8 +109,8 @@ def mha_reference(q, k, v, mask=None, causal=False, scale=None,
     m = jnp.max(s, axis=-1, keepdims=True)
     e = jnp.exp(s - m)
     l = jnp.sum(e, axis=-1, keepdims=True)
-    o = jnp.einsum("bhqk,bhkd->bhqd", e / l,
-                   v.astype(jnp.float32)).astype(q.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", e / l, v.astype(jnp.float32),
+                   precision=precision).astype(q.dtype)
     if return_lse:
         return o, m + jnp.log(l)
     return o

@@ -42,11 +42,17 @@ for dtype, tol in ((jnp.bfloat16, 5e-2), (jnp.float32, 2e-3)):
         return lambda a, b, c: (f(a, b, c, causal=True).astype(
             jnp.float32) * do.astype(jnp.float32)).sum()
     o = flash_attention(q, k, v, causal=True)
-    r = mha_reference(q, k, v, causal=True)
+    # Oracle at precision='highest': at DEFAULT the MXU rounds the
+    # oracle's fp32 operands to bf16, making the ground truth LESS
+    # accurate than the kernel under test (seen live: 6e-3 fp32 'error'
+    # that was really the oracle's).
+    import functools
+    ref = functools.partial(mha_reference, precision="highest")
+    r = ref(q, k, v, causal=True)
     err = float(jnp.abs(o.astype(jnp.float32) - r.astype(jnp.float32)).max())
     assert err < tol, ("fwd", dtype, err)
     gf = jax.jit(jax.grad(loss(flash_attention), argnums=(0, 1, 2)))(q, k, v)
-    gr = jax.jit(jax.grad(loss(mha_reference), argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss(ref), argnums=(0, 1, 2)))(q, k, v)
     for name, a, b in zip("qkv", gf, gr):
         ga = jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)).max()
         scale = max(1.0, float(jnp.abs(b.astype(jnp.float32)).max()))
@@ -118,6 +124,20 @@ def run_stage(name, argv, timeout, env_extra):
     except subprocess.TimeoutExpired:
         rc = -9
     log("stage {} done rc={} ({:.0f}s)".format(name, rc, time.time() - t0))
+    if rc != 0:
+        # Preserve the failed attempt's evidence: a later retry reopens
+        # <stage>.out with mode 'w', and 'never erase evidence' is the
+        # whole point of this collector.
+        n = 1
+        while os.path.exists(os.path.join(
+                RUNS, "{}.fail{}.out".format(name, n))):
+            n += 1
+        for src, suffix in ((out, "out"), (err, "err")):
+            try:
+                os.replace(src, os.path.join(
+                    RUNS, "{}.fail{}.{}".format(name, n, suffix)))
+            except OSError:
+                pass
     return rc == 0
 
 
@@ -125,6 +145,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--budget", type=float, default=6 * 3600)
     ap.add_argument("--stages", default=",".join(s[0] for s in STAGES))
+    ap.add_argument("--fresh", action="store_true",
+                    help="ignore battery_results.json passes from a "
+                         "previous run (use after code changes: resume "
+                         "otherwise trusts stale artifacts and may skip "
+                         "every stage)")
     args = ap.parse_args()
     os.makedirs(RUNS, exist_ok=True)
     want = [s.strip() for s in args.stages.split(",") if s.strip()]
@@ -134,20 +159,48 @@ def main():
         ap.error("unknown stage(s) {} (known: {})".format(
             unknown, sorted(known)))
     deadline = time.time() + args.budget
+    # Resume across restarts: stages that already passed (recorded in
+    # battery_results.json) are not re-run, and failed stages are retried
+    # in passes until everything passed or the budget is spent — a relay
+    # wedge mid-stage costs one attempt, never the artifact.
+    results_path = os.path.join(RUNS, "battery_results.json")
     results = {}
-    for name, argv, timeout, env_extra in STAGES:
-        if name not in want:
-            continue
-        if not wait_for_chip(deadline):
-            log("budget exhausted waiting for chip; stopping")
+    if not args.fresh:
+        try:
+            with open(results_path) as f:
+                results = {k: v for k, v in json.load(f).items() if v}
+        except (OSError, ValueError):
+            pass
+    ordinal = 0
+    while time.time() < deadline:
+        ordinal += 1
+        pending = [s for s in STAGES
+                   if s[0] in want and not results.get(s[0])]
+        if not pending:
             break
-        results[name] = run_stage(
-            name, argv, min(timeout, max(60, deadline - time.time())),
-            env_extra)
-    with open(os.path.join(RUNS, "battery_results.json"), "w") as f:
-        json.dump(results, f, indent=1)
+        if ordinal > 1:
+            # Inter-pass backoff: a stage failing for a non-wedge reason
+            # (bad flag, import error) exits in seconds, and without a
+            # pause the loop would re-run it back-to-back for the whole
+            # budget.
+            pause = min(120.0 * (ordinal - 1),
+                        600.0, max(0.0, deadline - time.time()))
+            log("pass {} backoff {:.0f}s".format(ordinal, pause))
+            time.sleep(pause)
+        log("pass {} starting; pending: {}".format(
+            ordinal, [s[0] for s in pending]))
+        for name, argv, timeout, env_extra in pending:
+            if not wait_for_chip(deadline):
+                log("budget exhausted waiting for chip")
+                break
+            results[name] = run_stage(
+                name, argv, min(timeout, max(60, deadline - time.time())),
+                env_extra)
+            with open(results_path, "w") as f:
+                json.dump(results, f, indent=1)
     log("battery complete: {}".format(results))
-    return 0 if results and all(results.values()) else 1
+    return 0 if results and all(
+        results.get(n) for n in want) else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,102 @@
+"""Tests for the TPU measurement battery's retry/resume loop.
+
+The battery is the round's evidence collector on a relay that wedges
+mid-stage (see tests/perf/tpu_battery.py). These tests pin the loop
+contract: a failed stage is retried on the next pass, a passed stage is
+never re-run (within a run OR across restarts via battery_results.json),
+and the budget bounds the whole thing.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_BATTERY = os.path.join(os.path.dirname(__file__), "..", "perf",
+                        "tpu_battery.py")
+
+
+@pytest.fixture()
+def battery(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location("ds_battery", _BATTERY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "RUNS", str(tmp_path))
+    monkeypatch.setattr(mod, "log", lambda msg: None)
+    return mod
+
+
+def _run(battery, monkeypatch, stage_results, argv=(), prior=None):
+    """Drive main() with scripted per-stage outcomes.
+
+    stage_results maps stage name -> list of successive attempt outcomes;
+    once exhausted, further attempts repeat the last value.
+    """
+    attempts = {}
+
+    def fake_run_stage(name, cmd, timeout, env):
+        outcomes = stage_results.get(name, [True])
+        i = attempts.get(name, 0)
+        attempts[name] = i + 1
+        return outcomes[min(i, len(outcomes) - 1)]
+
+    monkeypatch.setattr(battery, "run_stage", fake_run_stage)
+    monkeypatch.setattr(battery, "wait_for_chip", lambda deadline: True)
+    if prior is not None:
+        with open(os.path.join(battery.RUNS,
+                               "battery_results.json"), "w") as f:
+            json.dump(prior, f)
+    monkeypatch.setattr(battery.sys, "argv",
+                        ["tpu_battery.py"] + list(argv))
+    rc = battery.main()
+    with open(os.path.join(battery.RUNS, "battery_results.json")) as f:
+        return rc, attempts, json.load(f)
+
+
+def test_failed_stage_retried_next_pass(battery, monkeypatch):
+    rc, attempts, results = _run(
+        battery, monkeypatch,
+        {"smoke": [False, True]},
+        argv=["--stages", "smoke,headline"])
+    assert rc == 0
+    assert attempts["smoke"] == 2
+    assert attempts["headline"] == 1  # passed on pass 1, not re-run
+    assert results == {"smoke": True, "headline": True}
+
+
+def test_passed_stages_resume_from_artifact(battery, monkeypatch):
+    rc, attempts, results = _run(
+        battery, monkeypatch,
+        {"headline": [True]},
+        argv=["--stages", "smoke,headline"],
+        prior={"smoke": True, "headline": False})
+    assert rc == 0
+    assert "smoke" not in attempts  # already recorded as passed
+    assert attempts["headline"] == 1
+    assert results["smoke"] is True and results["headline"] is True
+
+
+def test_budget_bounds_retries(battery, monkeypatch):
+    clock = {"t": 0.0}
+    monkeypatch.setattr(battery.time, "time", lambda: clock["t"])
+
+    def fake_run_stage(name, cmd, timeout, env):
+        clock["t"] += 100.0
+        return False
+
+    monkeypatch.setattr(battery, "run_stage", fake_run_stage)
+    monkeypatch.setattr(battery, "wait_for_chip", lambda deadline: True)
+    monkeypatch.setattr(battery.sys, "argv",
+                        ["tpu_battery.py", "--stages", "smoke",
+                         "--budget", "250"])
+    rc = battery.main()
+    assert rc == 1  # never succeeded, but terminated within budget
+    assert clock["t"] <= 400.0  # 3 passes max at 100s/attempt
+
+
+def test_unknown_stage_rejected(battery, monkeypatch):
+    monkeypatch.setattr(battery.sys, "argv",
+                        ["tpu_battery.py", "--stages", "nope"])
+    with pytest.raises(SystemExit):
+        battery.main()
