@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from deepspeed_tpu.ops.transformer.kernels.attention import _mxu_precision
+
 NEG_INF = -1e30
 
 
@@ -127,7 +129,7 @@ def _unpack(refs, n_out, has_kpm, has_bias):
 
 
 def _fwd_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
-                bias_mode):
+                bias_mode, precision):
     (q_ref, k_ref, v_ref, lut_ref, kpm_ref, bias_ref,
      (o_ref, lse_ref)) = _unpack(refs, 2, has_kpm, has_bias)
 
@@ -144,7 +146,8 @@ def _fwd_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
         k_blk = k_ref[0, 0, pl.ds(c * blk, blk)].astype(jnp.float32)
         v_blk = v_ref[0, 0, pl.ds(c * blk, blk)].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32,
+                                precision=precision)
         kpm_blk = (kpm_ref[0, pl.ds(c * blk, blk)][None, :]
                    if kpm_ref is not None else None)
         bias_blk = (bias_ref[0, 0, :, pl.ds(c * blk, blk)]
@@ -160,7 +163,7 @@ def _fwd_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=precision)
         return acc, m_new, l_new
 
     acc, m, l = jax.lax.fori_loop(
@@ -175,7 +178,7 @@ def _fwd_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
 
 
 def _bwd_dq_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
-                   bias_mode):
+                   bias_mode, precision):
     (q_ref, k_ref, v_ref, lut_ref, kpm_ref, bias_ref,
      (do_ref, lse_ref, delta_ref, dq_ref)) = _unpack(refs, 1, has_kpm, has_bias)
 
@@ -193,7 +196,8 @@ def _bwd_dq_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
         k_blk = k_ref[0, 0, pl.ds(c * blk, blk)].astype(jnp.float32)
         v_blk = v_ref[0, 0, pl.ds(c * blk, blk)].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32,
+                                precision=precision) * scale
         kpm_blk = (kpm_ref[0, pl.ds(c * blk, blk)][None, :]
                    if kpm_ref is not None else None)
         bias_blk = (bias_ref[0, 0, :, pl.ds(c * blk, blk)]
@@ -202,7 +206,8 @@ def _bwd_dq_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
                          kpm_mode, bias_mode)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32,
+                                 precision=precision)
         ds = p * (dp - delta) * scale
         # In mul-mask modes the mask scales the pre-softmax score, so it also
         # scales the score gradient flowing back to q/k.
@@ -211,7 +216,8 @@ def _bwd_dq_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
         if bias_blk is not None and bias_mode == 'mul':
             ds = ds * bias_blk
         return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+                                        preferred_element_type=jnp.float32,
+                                        precision=precision)
 
     dq = jax.lax.fori_loop(0, lut_ref.shape[2], body,
                            jnp.zeros((bq, d), jnp.float32))
@@ -219,7 +225,7 @@ def _bwd_dq_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
 
 
 def _bwd_dkv_kernel(*refs, scale, blk, bq, causal, has_kpm, has_bias, kpm_mode,
-                    bias_mode):
+                    bias_mode, precision):
     (q_ref, k_ref, v_ref, tlut_ref, kpm_ref, bias_ref,
      (do_ref, lse_ref, delta_ref, dk_ref, dv_ref)) = _unpack(
          refs, 2, has_kpm, has_bias)
@@ -240,23 +246,27 @@ def _bwd_dkv_kernel(*refs, scale, blk, bq, causal, has_kpm, has_bias, kpm_mode,
         lse = lse_ref[0, 0, pl.ds(r * bq, bq)]
         delta = delta_ref[0, 0, pl.ds(r * bq, bq)]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32,
+                                precision=precision) * scale
         bias_blk = (bias_ref[0, 0, pl.ds(r * bq, bq), :]
                     if bias_ref is not None else None)
         s = _apply_masks(s, r * bq, jk, blk, kpm_blk, bias_blk, valid, causal,
                          kpm_mode, bias_mode)
         p = jnp.exp(s - lse)                               # [bq, blk]
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+                                      preferred_element_type=jnp.float32,
+                                      precision=precision)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32,
+                                 precision=precision)
         ds = p * (dp - delta) * scale
         if kpm_blk is not None and kpm_mode == 'mul':
             ds = ds * kpm_blk
         if bias_blk is not None and bias_mode == 'mul':
             ds = ds * bias_blk
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+                                      preferred_element_type=jnp.float32,
+                                      precision=precision)
         return dk, dv
 
     dk, dv = jax.lax.fori_loop(
@@ -275,13 +285,13 @@ _FN_CACHE = {}
 
 
 def _make_fn(fwd_lut, bwd_lut, blk, scale, causal, has_kpm, has_bias,
-             kpm_mode, bias_mode):
+             kpm_mode, bias_mode, precision=None):
     # LUTs stay numpy in the closure; they are converted per call so that a
     # closure first built under a jit trace never caches tracer constants.
     fwd_lut = np.asarray(fwd_lut)
     bwd_lut = np.asarray(bwd_lut)
     flags = dict(causal=causal, has_kpm=has_kpm, has_bias=has_bias,
-                 kpm_mode=kpm_mode, bias_mode=bias_mode)
+                 kpm_mode=kpm_mode, bias_mode=bias_mode, precision=precision)
 
     def fwd(q, k, v, kpm, bias):
         b, h, t, d = q.shape
@@ -416,7 +426,7 @@ def _make_fn(fwd_lut, bwd_lut, blk, scale, causal, has_kpm, has_bias,
                 # kernel exists for.
                 q_h, k_h, v_h, do_h, lse_h, delta_h, bias_h, valid_h = args
                 s = jnp.einsum("bqd,bkd->bqk", q_h.astype(f32),
-                               k_h.astype(f32),
+                               k_h.astype(f32), precision=precision,
                                preferred_element_type=f32) * scale
                 if kpm_b is not None:
                     s = s * kpm_b if kpm_mode == 'mul' else s + kpm_b
@@ -426,7 +436,8 @@ def _make_fn(fwd_lut, bwd_lut, blk, scale, causal, has_kpm, has_bias,
                 s = jnp.where(valid_h[None], s, NEG_INF)
                 p = jnp.exp(s - lse_h.astype(f32))
                 dp = jnp.einsum("bqd,bkd->bqk", do_h.astype(f32),
-                                v_h.astype(f32), preferred_element_type=f32)
+                                v_h.astype(f32), precision=precision,
+                                preferred_element_type=f32)
                 dS = p * (dp - delta_h.astype(f32))
                 out = dS if bias_mode != 'mul' else dS * s_pre_bias
                 return jnp.where(valid_h[None], out, 0.0).astype(bias.dtype)
@@ -472,16 +483,21 @@ def block_sparse_attention(q, k, v, layout, block, scale=None, causal=False,
     if layout.shape[0] != h:
         raise ValueError('layout heads {} != tensor heads {}'.format(
             layout.shape[0], h))
+    # fp32 models contract at HIGHEST: the kernels accumulate in fp32, but
+    # at DEFAULT the MXU rounds the fp32 OPERANDS to bf16 — fine when the
+    # inputs started as bf16/fp16, silently lossy for fp32 parity.
+    precision = _mxu_precision(q.dtype)
     key = (layout.tobytes(), layout.shape, int(block), float(scale),
            bool(causal), key_padding_mask is not None,
-           attn_bias is not None, key_padding_mask_mode, attn_bias_mode)
+           attn_bias is not None, key_padding_mask_mode, attn_bias_mode,
+           precision)
     fn = _FN_CACHE.get(key)
     if fn is None:
         fwd_lut, bwd_lut = build_luts(layout)
         fn = _make_fn(fwd_lut, bwd_lut, int(block), float(scale),
                       bool(causal), key_padding_mask is not None,
                       attn_bias is not None, key_padding_mask_mode,
-                      attn_bias_mode)
+                      attn_bias_mode, precision=precision)
         _FN_CACHE[key] = fn
     return fn(q, k, v, key_padding_mask, attn_bias)
 
@@ -494,9 +510,12 @@ def block_sparse_attention_reference(q, k, v, layout, block, scale=None,
     """Dense jnp ground truth: expand the block layout to an elementwise mask
     and run ordinary softmax attention. Used by parity tests.
 
-    precision: forwarded to the einsums; on-TPU oracle callers must pass
-    'highest' (DEFAULT rounds the fp32 operands to bf16 on the MXU, making
-    the ground truth less accurate than the kernel under test)."""
+    precision: forwarded to the einsums. When None, fp32 inputs default to
+    'highest' — on TPU, DEFAULT rounds the fp32 operands to bf16 on the
+    MXU, which would make the ground truth LESS accurate than the kernel
+    under test (the kernel applies the same fp32->HIGHEST rule)."""
+    if precision is None:
+        precision = _mxu_precision(q.dtype)
     b, h, t, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
@@ -515,10 +534,9 @@ def block_sparse_attention_reference(q, k, v, layout, block, scale=None,
         s = jnp.where(cm[None, None], s, NEG_INF)
     s = jnp.where(jnp.asarray(dense, dtype=bool)[None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    # Fully-masked rows (no active blocks) produce zeros, matching the kernel.
+    # Fully-masked rows (no active blocks) produce zeros, matching the
+    # kernel. Causality is already folded into `s` above.
     row_any = jnp.asarray(dense.any(-1), dtype=bool)[None, :, :, None]
-    if causal:
-        pass
     p = jnp.where(row_any, p, 0.0)
     return jnp.einsum('bhqk,bhkd->bhqd', p, v.astype(jnp.float32),
                       precision=precision).astype(q.dtype)

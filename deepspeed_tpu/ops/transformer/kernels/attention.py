@@ -84,14 +84,15 @@ def mha_reference(q, k, v, mask=None, causal=False, scale=None,
     returns the per-row logsumexp [B, H, T, 1] fp32 (the ragged fallback
     of flash_attention_with_lse shares this single dense implementation).
 
-    precision: forwarded to the two einsums. Production fallback callers
-    leave the DEFAULT (on the TPU MXU that is a single bf16-input pass —
-    fast, and consistent with the recompute in ring attention's dense
-    backward, so fwd/bwd rounding cancels). Parity/oracle callers that
-    compare the KERNEL against this function on real TPU hardware must
-    pass 'highest': at DEFAULT the oracle's fp32 (or fp16-origin)
-    operands are rounded to bf16, making the ground truth LESS accurate
-    than the kernel under test."""
+    precision: forwarded to the two einsums. When None, low-precision
+    inputs keep the MXU DEFAULT (a single bf16-input pass — fast, and
+    consistent with the recompute in ring attention's dense backward, so
+    fwd/bwd rounding cancels) while fp32 inputs contract at HIGHEST: at
+    DEFAULT the MXU rounds fp32 operands to bf16, which would make both
+    the fp32 production fallback lossy and a parity oracle LESS accurate
+    than the kernel under test (the kernel applies the same rule)."""
+    if precision is None:
+        precision = _mxu_precision(q.dtype)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -140,6 +141,19 @@ def _is_lowp(dtype):
     return jnp.dtype(dtype) in (jnp.bfloat16, jnp.float16)
 
 
+def _mxu_precision(dtype):
+    """Dot precision for the kernel's MXU contractions, by model dtype.
+
+    At DEFAULT precision the MXU rounds fp32 operands to bf16 — fine for
+    low-precision models (operands already are bf16/fp16), but it silently
+    costs fp32 models ~1e-2 parity now that the softmax row-sum and the
+    `dp - delta` correction ride the matmuls (the denominator inherits p's
+    operand rounding; seen live on v5e: 9e-3 fwd error vs a
+    precision-highest oracle). fp32 is the parity/debug path, so it takes
+    HIGHEST (multi-pass MXU, ~fp32-exact) and keeps the fusions."""
+    return None if _is_lowp(dtype) else jax.lax.Precision.HIGHEST
+
+
 def _exp_lowp(t, dtype):
     """exp over a [bq, bk] block — the widest VPU pass in the kernel.
 
@@ -163,7 +177,8 @@ def _pv_rowsum(p, v_blk):
         [v_blk, jnp.ones((v_blk.shape[0], 1), v_blk.dtype)], axis=1)
     pv_ext = jax.lax.dot_general(p.astype(v_blk.dtype), v_ext,
                                  (((1,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32,
+                                 precision=_mxu_precision(v_blk.dtype))
     return pv_ext[:, :d], pv_ext[:, d:d + 1]
 
 
@@ -208,7 +223,8 @@ def _dp_minus_delta(do, v_blk, delta):
     v_ext = jnp.concatenate(
         [v_blk, jnp.ones((v_blk.shape[0], 1), dtype)], axis=1)
     return jax.lax.dot_general(do_ext, v_ext, (((1,), (1,)), ((), ())),
-                               preferred_element_type=jnp.float32)
+                               preferred_element_type=jnp.float32,
+                               precision=_mxu_precision(dtype))
 
 
 def _apply_causal(s, iq, j, block_q, block_k, tril_ref):
@@ -250,7 +266,8 @@ def _fwd_kernel(*refs, causal, block_q, block_k, has_mask, has_tril,
     def scores():
         s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
                                 (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32,
+                                precision=_mxu_precision(q_ref.dtype))
         if mask_ref is not None:
             s = s + mask_ref[0][None, :]
         if causal:
@@ -397,7 +414,8 @@ def _bwd_scores(q_ref, k_ref, mask_ref, tril_ref, iq, j, causal,
                 block_q, block_k):
     s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
                             (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=jnp.float32,
+                            precision=_mxu_precision(q_ref.dtype))
     if mask_ref is not None:
         s = s + mask_ref[0][None, :]
     if causal:
@@ -424,7 +442,8 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, has_mask,
         dpd = _dp_minus_delta(do_ref[0, 0], v_ref[0, 0], delta_ref[0, 0])
         ds = (p * dpd).astype(k_ref.dtype)
         return jax.lax.dot_general(ds, k_ref[0, 0], (((1,), (0,)), ((), ())),
-                                   preferred_element_type=jnp.float32)
+                                   preferred_element_type=jnp.float32,
+                                   precision=_mxu_precision(k_ref.dtype))
 
     if single_kv:
         dq_ref[0, 0] = (ds_block() * scale).astype(dq_ref.dtype)
@@ -469,11 +488,13 @@ def _bwd_dkv_kernel(*refs, causal, block_q, block_k, has_mask, has_tril,
         do = do_ref[0, 0]
         dv = jax.lax.dot_general(p.astype(do.dtype), do,
                                  (((0,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32,
+                                 precision=_mxu_precision(do.dtype))
         dpd = _dp_minus_delta(do, v_ref[0, 0], delta_ref[0, 0])
         ds = (p * dpd).astype(q_ref.dtype)
         dk = jax.lax.dot_general(ds, q_ref[0, 0], (((0,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32,
+                                 precision=_mxu_precision(q_ref.dtype))
         return dk, dv
 
     if single_q:

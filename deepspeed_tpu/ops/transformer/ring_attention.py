@@ -44,8 +44,8 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.transformer.kernels.attention import (
-    NEG_INF, _flash_bwd_pallas, _flash_fwd_pallas, flash_attention_with_lse,
-    mha_reference, resolve_block_sizes)
+    NEG_INF, _flash_bwd_pallas, _flash_fwd_pallas, _mxu_precision,
+    flash_attention_with_lse, mha_reference, resolve_block_sizes)
 
 
 def _merge(o_a, lse_a, o_b, lse_b):
@@ -71,9 +71,15 @@ def _dense_block_fwd(q, k, v, mask, scale, causal):
 
 def _dense_block_bwd(q, k, v, mask, delta, lse, do, scale, causal):
     """Dense jnp per-block flash backward with GLOBAL row statistics:
-    p = exp(s - lse), ds = p * (dp - delta)."""
+    p = exp(s - lse), ds = p * (dp - delta).
+
+    The recomputed s must round the same way the forward (mha_reference)
+    did, or p no longer matches the saved lse — so the einsums share the
+    forward's dtype-dependent precision rule (fp32 -> HIGHEST on the MXU,
+    bf16/fp16 -> DEFAULT, where fwd/bwd rounding cancels)."""
+    prec = _mxu_precision(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+                   k.astype(jnp.float32), precision=prec) * scale
     if mask is not None:
         s = s + mask[:, None, None, :].astype(jnp.float32)
     if causal:
@@ -85,11 +91,14 @@ def _dense_block_bwd(q, k, v, mask, delta, lse, do, scale, causal):
     # +64 would poison the whole step with inf grads.
     p = jnp.exp(jnp.minimum(s - lse, 0.0))
     do32 = do.astype(jnp.float32)
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v.astype(jnp.float32))
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32, precision=prec)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v.astype(jnp.float32),
+                    precision=prec)
     ds = p * (dp - delta) * scale
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32),
+                    precision=prec)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32),
+                    precision=prec)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

@@ -12,12 +12,16 @@ Usage: python tests/perf/attention_bench.py [--seq 1024] [--batch 8]
 """
 
 import argparse
+import os
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
 
 from deepspeed_tpu.ops.transformer.kernels.attention import (
     flash_attention, mha_reference)

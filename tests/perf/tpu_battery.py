@@ -30,6 +30,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 RUNS = os.path.join(REPO, ".tpu_runs")
 
 SMOKE = """
+import functools
 import jax, jax.numpy as jnp
 from deepspeed_tpu.ops.transformer.kernels.attention import (
     flash_attention, mha_reference)
@@ -46,7 +47,6 @@ for dtype, tol in ((jnp.bfloat16, 5e-2), (jnp.float32, 2e-3)):
     # oracle's fp32 operands to bf16, making the ground truth LESS
     # accurate than the kernel under test (seen live: 6e-3 fp32 'error'
     # that was really the oracle's).
-    import functools
     ref = functools.partial(mha_reference, precision="highest")
     r = ref(q, k, v, causal=True)
     err = float(jnp.abs(o.astype(jnp.float32) - r.astype(jnp.float32)).max())
@@ -69,6 +69,7 @@ STAGES = [
     ("attn", ["tests/perf/attention_bench.py", "--dense"], 2400, {}),
     ("attn2048", ["tests/perf/attention_bench.py", "--seq", "2048",
                   "--batch", "4", "--dense"], 2400, {}),
+    ("head", ["tests/perf/head_bench.py"], 2400, {}),
     ("sweep", ["bench.py", "--sweep"], 4200,
      {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1"}),
     ("xl_compute", ["bench.py", "--xl-compute"], 2400,
@@ -113,7 +114,10 @@ def wait_for_chip(deadline):
 def run_stage(name, argv, timeout, env_extra):
     out = os.path.join(RUNS, name + ".out")
     err = os.path.join(RUNS, name + ".err")
+    # Stage scripts import deepspeed_tpu; cwd alone does not put the repo
+    # on sys.path for `python tests/perf/x.py` invocations.
     env = dict(os.environ, **env_extra)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     log("stage {} starting (timeout {}s)".format(name, timeout))
     t0 = time.time()
     try:
@@ -127,17 +131,21 @@ def run_stage(name, argv, timeout, env_extra):
     if rc != 0:
         # Preserve the failed attempt's evidence: a later retry reopens
         # <stage>.out with mode 'w', and 'never erase evidence' is the
-        # whole point of this collector.
+        # whole point of this collector. Slot n is free only if NEITHER
+        # suffix exists there — a half-renamed earlier attempt (one
+        # os.replace failed) must not get its surviving half overwritten.
         n = 1
-        while os.path.exists(os.path.join(
-                RUNS, "{}.fail{}.out".format(name, n))):
+        while any(os.path.exists(os.path.join(
+                RUNS, "{}.fail{}.{}".format(name, n, sfx)))
+                for sfx in ("out", "err")):
             n += 1
         for src, suffix in ((out, "out"), (err, "err")):
             try:
                 os.replace(src, os.path.join(
                     RUNS, "{}.fail{}.{}".format(name, n, suffix)))
-            except OSError:
-                pass
+            except OSError as e:
+                log("stage {}: could not archive {}: {}".format(
+                    name, src, e))
     return rc == 0
 
 

@@ -17,6 +17,24 @@ _BATTERY = os.path.join(os.path.dirname(__file__), "..", "perf",
                         "tpu_battery.py")
 
 
+class _FakeTime:
+    """Stand-in for the battery module's `time` binding: sleeps advance a
+    fake clock instead of blocking (the inter-pass backoff is minutes of
+    real wall otherwise), and tests can read/advance `.t` directly."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def time(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += max(0.0, s)
+
+    def strftime(self, fmt):
+        return "fake"
+
+
 @pytest.fixture()
 def battery(monkeypatch, tmp_path):
     spec = importlib.util.spec_from_file_location("ds_battery", _BATTERY)
@@ -24,6 +42,9 @@ def battery(monkeypatch, tmp_path):
     spec.loader.exec_module(mod)
     monkeypatch.setattr(mod, "RUNS", str(tmp_path))
     monkeypatch.setattr(mod, "log", lambda msg: None)
+    # Rebind the module-level `time` name only — patching time.time on the
+    # shared stdlib module would leak a fake clock process-wide.
+    monkeypatch.setattr(mod, "time", _FakeTime())
     return mod
 
 
@@ -78,11 +99,10 @@ def test_passed_stages_resume_from_artifact(battery, monkeypatch):
 
 
 def test_budget_bounds_retries(battery, monkeypatch):
-    clock = {"t": 0.0}
-    monkeypatch.setattr(battery.time, "time", lambda: clock["t"])
+    clock = battery.time  # the fixture's _FakeTime
 
     def fake_run_stage(name, cmd, timeout, env):
-        clock["t"] += 100.0
+        clock.t += 100.0
         return False
 
     monkeypatch.setattr(battery, "run_stage", fake_run_stage)
@@ -92,7 +112,7 @@ def test_budget_bounds_retries(battery, monkeypatch):
                          "--budget", "250"])
     rc = battery.main()
     assert rc == 1  # never succeeded, but terminated within budget
-    assert clock["t"] <= 400.0  # 3 passes max at 100s/attempt
+    assert clock.t <= 400.0  # bounded: attempts + backoff within budget
 
 
 def test_unknown_stage_rejected(battery, monkeypatch):
