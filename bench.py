@@ -237,7 +237,9 @@ def _emit(result):
             result["extra"]["vs_baseline_source"] = "last_good_tpu"
             result["vs_baseline"] = last.get("vs_baseline",
                                              result["vs_baseline"])
-    print(json.dumps(result))
+    # flush: under the battery/supervisor stdout is a file; a later wedge
+    # must not take this already-earned result line with it.
+    print(json.dumps(result), flush=True)
     if result["extra"].get("platform") == "tpu" and not fallback:
         _record_last_good(result)
 

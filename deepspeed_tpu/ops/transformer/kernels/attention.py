@@ -545,6 +545,150 @@ def _bwd_dkv_kernel(*refs, causal, block_q, block_k, has_mask, has_tril,
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(*refs, scale, causal, block_q, block_k, has_mask,
+                      has_tril):
+    """One-pass backward: dq, dk, dv from a single sweep over (i, j) block
+    pairs. The split kernels each recompute s, p and dO.V^T per pair —
+    7 score-sized matmuls + 2 exp passes per pair total; this kernel does
+    5 matmuls + 1 exp (the MXU-ideal count), with k/v resident in VMEM per
+    (b, h) and full-length fp32 dk/dv accumulators in scratch. It also
+    reads k and v from HBM once per (b, h) instead of once per q block."""
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    idx = 3
+    mask_ref = tril_ref = None
+    if has_mask:
+        mask_ref = refs[idx]
+        idx += 1
+    if has_tril:
+        tril_ref = refs[idx]
+        idx += 1
+    do_ref, lse_ref, delta_ref = refs[idx:idx + 3]
+    dq_ref, dk_ref, dv_ref = refs[idx + 3:idx + 6]
+    dk_acc, dv_acc = refs[idx + 6:idx + 8]
+
+    i = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    t_kv = k_ref.shape[2]
+    n_kv = t_kv // block_k
+    d = q_ref.shape[-1]
+    prec = _mxu_precision(q_ref.dtype)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q_blk = q_ref[0, 0]
+    do_blk = do_ref[0, 0]
+    lse_blk = lse_ref[0, 0]
+    delta_blk = delta_ref[0, 0]
+
+    def body(j, dq_local):
+        kv = pl.ds(j * block_k, block_k)
+        k_blk = k_ref[0, 0, kv]
+        v_blk = v_ref[0, 0, kv]
+        s = jax.lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=prec)
+        if mask_ref is not None:
+            s = s + mask_ref[0, kv][None, :]
+        if causal:
+            s = _apply_causal(s, i, j, block_q, block_k, tril_ref)
+        # s <= lse mathematically; the clamp guards fully-masked rows
+        # (same contract as the split kernels).
+        p = _exp_lowp(jnp.minimum(s - lse_blk, 0.0), dq_ref.dtype)
+        dv_acc[kv] += jax.lax.dot_general(
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        dpd = _dp_minus_delta(do_blk, v_blk, delta_blk)
+        ds = (p * dpd).astype(k_ref.dtype)
+        dk_acc[kv] += jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        return dq_local + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+
+    if causal:
+        n_j = jnp.minimum(_last_kv_block(i, block_q, block_k) + 1, n_kv)
+    else:
+        n_j = n_kv
+    dq_local = jax.lax.fori_loop(
+        0, n_j, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0, 0] = (dq_local * scale).astype(dq_ref.dtype)
+
+    @pl.when(i == n_q - 1)
+    def _emit():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+# Per-(b, h) VMEM for the fused backward: k/v inputs + dk/dv outputs in
+# the model dtype, plus two full-length fp32 accumulators. Beyond the
+# budget (very long kv at large d) the split two-kernel path streams
+# blocks instead. Overridable for experiments.
+_FUSED_BWD_VMEM_BUDGET = int(os.environ.get(
+    "DS_TPU_FUSED_BWD_MAX_BYTES", 12 * 1024 * 1024))
+
+
+def _bwd_mode(t_kv, d, dtype):
+    """'fused' or 'split' — env DS_TPU_FLASH_BWD overrides the VMEM fit."""
+    mode = os.environ.get("DS_TPU_FLASH_BWD", "auto")
+    if mode in ("fused", "split"):
+        return mode
+    itemsize = jnp.dtype(dtype).itemsize
+    resident = t_kv * d * (4 * itemsize + 2 * 4)
+    return "fused" if resident <= _FUSED_BWD_VMEM_BUDGET else "split"
+
+
+def _flash_bwd_fused_pallas(q, k, v, mask, delta, lse, do, scale, causal,
+                            block_q, block_k):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, t_q, d = q.shape
+    t_kv = k.shape[2]
+    n_q = pl.cdiv(t_q, block_q)
+    use_tril = causal and block_q == block_k
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d),
+                          lambda b_, h_, i: (b_, h_, i, 0))
+    kv_full = pl.BlockSpec((1, 1, t_kv, d), lambda b_, h_, i: (b_, h_, 0, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q, 1),
+                            lambda b_, h_, i: (b_, h_, i, 0))
+
+    in_specs = [q_spec, kv_full, kv_full]
+    args = [q, k, v]
+    if mask is not None:
+        in_specs.append(pl.BlockSpec((1, t_kv), lambda b_, h_, i: (b_, 0)))
+        args.append(mask.astype(jnp.float32))
+    if use_tril:
+        in_specs.append(
+            pl.BlockSpec((block_q, block_k), lambda b_, h_, i: (0, 0)))
+        args.append(_tril_block(block_q, block_k))
+    in_specs += [q_spec, row_spec, row_spec]
+    args += [do, lse, delta]
+
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          has_mask=mask is not None, has_tril=use_tril),
+        grid=(b, h, n_q),
+        in_specs=in_specs,
+        out_specs=[q_spec, kv_full, kv_full],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((t_kv, d), jnp.float32),
+                        pltpu.VMEM((t_kv, d), jnp.float32)],
+        interpret=_interpret(),
+    )(*args)
+    # Tuple, not pallas_call's list: the custom_partitioning wrapper
+    # declares tuple outputs and jax's out-tree flattening is
+    # container-type strict.
+    return dq, dk, dv
+
+
 def _flash_bwd_pallas(q, k, v, mask, delta, lse, g, scale, causal, block_q,
                       block_k):
     """delta: [B, H, T, 1] fp32 = rowsum(dO * O) (minus any lse cotangent —
@@ -562,6 +706,9 @@ def _flash_bwd_pallas(q, k, v, mask, delta, lse, g, scale, causal, block_q,
     # Same pre-scaled q as the forward (so the recomputed P matches the
     # saved lse); dk needs no correction, dq is rescaled on its output.
     q = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    if _bwd_mode(t_kv, d, q.dtype) == "fused":
+        return _flash_bwd_fused_pallas(q, k, v, mask, delta, lse, do, scale,
+                                       causal, block_q, block_k)
     use_tril = causal and block_q == block_k
     tril = _tril_block(block_q, block_k) if use_tril else None
 

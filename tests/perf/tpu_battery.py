@@ -118,6 +118,10 @@ def run_stage(name, argv, timeout, env_extra):
     # on sys.path for `python tests/perf/x.py` invocations.
     env = dict(os.environ, **env_extra)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # Stage stdout goes to a file, so Python block-buffers it: a stage
+    # killed mid-run (relay wedge) would take its already-printed result
+    # lines with it. Seen live: a 26-minute sweep died with an empty .out.
+    env["PYTHONUNBUFFERED"] = "1"
     log("stage {} starting (timeout {}s)".format(name, timeout))
     t0 = time.time()
     try:

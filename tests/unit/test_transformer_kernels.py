@@ -61,12 +61,49 @@ def test_flash_attention_backward(causal):
         np.testing.assert_allclose(a, b_, rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.parametrize("mode", ["fused", "split"])
+@pytest.mark.parametrize("causal,use_mask", [(False, False), (True, False),
+                                             (True, True)])
+def test_flash_attention_backward_modes_agree(monkeypatch, mode, causal,
+                                              use_mask):
+    """The fused one-pass backward and the split dq/dkv kernels must both
+    match the dense oracle — DS_TPU_FLASH_BWD selects the path (the auto
+    heuristic picks fused whenever k/v + accumulators fit VMEM)."""
+    monkeypatch.setenv("DS_TPU_FLASH_BWD", mode)
+    rng = np.random.RandomState(11)
+    b, h, t, d = 2, 2, 96, 32
+    q, k, v = rand(rng, b, h, t, d), rand(rng, b, h, t, d), rand(rng, b, h, t, d)
+    mask = None
+    if use_mask:
+        mask = jnp.where(jnp.asarray(rng.rand(b, t)) > 0.25, 0.0,
+                         -1e9).astype(jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, mask=mask, causal=causal,
+                                       block_q=32, block_k=32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, mask=mask,
+                                     causal=causal) ** 2)
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g, gr):
+        np.testing.assert_allclose(a, b_, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("mode", ["fused", "split"])
 @pytest.mark.parametrize("t_q,t_kv,blk", [(16, 32, 16), (32, 16, 16),
                                           (16, 64, 16)])
-def test_flash_attention_backward_cross_lengths(t_q, t_kv, blk):
+def test_flash_attention_backward_cross_lengths(monkeypatch, t_q, t_kv, blk,
+                                                mode):
     """Causal grads with t_q != t_kv — regression for the single-q-block
     dkv path, where kv blocks entirely past the query extent must receive
-    zero gradient (they got unmasked garbage before the fix)."""
+    zero gradient (they got unmasked garbage before the fix). Parametrized
+    over both backward paths: auto would route these tiny shapes to the
+    fused kernel and leave the split kernels' cross-length handling
+    untested."""
+    monkeypatch.setenv("DS_TPU_FLASH_BWD", mode)
     rng = np.random.RandomState(5)
     b, h, d = 2, 2, 16
     q = rand(rng, b, h, t_q, d)
