@@ -65,7 +65,11 @@ def main():
     ap.add_argument("--blocks", default=None,
                     help="block_q,block_k (default: autotuner)")
     ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--bwd", default=None, choices=["auto", "fused", "split"],
+                    help="flash backward path (sets DS_TPU_FLASH_BWD)")
     args = ap.parse_args()
+    if args.bwd:
+        os.environ["DS_TPU_FLASH_BWD"] = args.bwd
 
     b, h, t, d = args.batch, args.heads, args.seq, args.dim
     dtype = jnp.dtype(args.dtype)

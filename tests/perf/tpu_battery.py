@@ -67,6 +67,8 @@ STAGES = [
     ("headline", ["bench.py"], 2400,
      {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1"}),
     ("attn", ["tests/perf/attention_bench.py", "--dense"], 2400, {}),
+    ("attn_split", ["tests/perf/attention_bench.py", "--bwd", "split"],
+     2400, {}),
     ("attn2048", ["tests/perf/attention_bench.py", "--seq", "2048",
                   "--batch", "4", "--dense"], 2400, {}),
     ("head", ["tests/perf/head_bench.py"], 2400, {}),
