@@ -29,7 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from deepspeed_tpu.ops.transformer.kernels.attention import _mxu_precision
+from deepspeed_tpu.ops.transformer.kernels.attention import (
+    _bwd_mode, _mxu_precision)
 
 NEG_INF = -1e30
 
@@ -177,6 +178,32 @@ def _fwd_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
     lse_ref[0, 0] = jnp.maximum(m, 0.5 * NEG_INF) + jnp.log(l)
 
 
+def _recompute_p_ds(q, do, lse, delta, k_blk, v_blk, kpm_blk, bias_blk,
+                    valid, q_start, c, blk, scale, causal, kpm_mode,
+                    bias_mode, precision):
+    """Shared backward block recompute for one (row, column) block pair:
+    s is rebuilt exactly as the forward built it (same masks, same
+    precision), then p = exp(s - lse) and ds = p * (dp - delta) * scale.
+    In mul-mask modes the mask scales the pre-softmax score, so it also
+    scales the score gradient ds flowing back to q/k. Used by all three
+    backward kernels so the split and fused paths cannot diverge."""
+    s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=precision) * scale
+    s = _apply_masks(s, q_start, c, blk, kpm_blk, bias_blk, valid, causal,
+                     kpm_mode, bias_mode)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=precision)
+    ds = p * (dp - delta) * scale
+    if kpm_blk is not None and kpm_mode == 'mul':
+        ds = ds * kpm_blk
+    if bias_blk is not None and bias_mode == 'mul':
+        ds = ds * bias_blk
+    return p, ds
+
+
 def _bwd_dq_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
                    bias_mode, precision):
     (q_ref, k_ref, v_ref, lut_ref, kpm_ref, bias_ref,
@@ -193,28 +220,14 @@ def _bwd_dq_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
         col = lut_ref[0, 0, j]
         valid = col >= 0
         c = jnp.maximum(col, 0)
-        k_blk = k_ref[0, 0, pl.ds(c * blk, blk)].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(c * blk, blk)].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32,
-                                precision=precision) * scale
-        kpm_blk = (kpm_ref[0, pl.ds(c * blk, blk)][None, :]
-                   if kpm_ref is not None else None)
-        bias_blk = (bias_ref[0, 0, :, pl.ds(c * blk, blk)]
-                    if bias_ref is not None else None)
-        s = _apply_masks(s, iq * bq, c, blk, kpm_blk, bias_blk, valid, causal,
-                         kpm_mode, bias_mode)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32,
-                                 precision=precision)
-        ds = p * (dp - delta) * scale
-        # In mul-mask modes the mask scales the pre-softmax score, so it also
-        # scales the score gradient flowing back to q/k.
-        if kpm_blk is not None and kpm_mode == 'mul':
-            ds = ds * kpm_blk
-        if bias_blk is not None and bias_mode == 'mul':
-            ds = ds * bias_blk
+        kv = pl.ds(c * blk, blk)
+        k_blk = k_ref[0, 0, kv].astype(jnp.float32)
+        v_blk = v_ref[0, 0, kv].astype(jnp.float32)
+        kpm_blk = kpm_ref[0, kv][None, :] if kpm_ref is not None else None
+        bias_blk = bias_ref[0, 0, :, kv] if bias_ref is not None else None
+        _, ds = _recompute_p_ds(q, do, lse, delta, k_blk, v_blk, kpm_blk,
+                                bias_blk, valid, iq * bq, c, blk, scale,
+                                causal, kpm_mode, bias_mode, precision)
         return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32,
                                         precision=precision)
@@ -222,6 +235,69 @@ def _bwd_dq_kernel(*refs, scale, blk, causal, has_kpm, has_bias, kpm_mode,
     dq = jax.lax.fori_loop(0, lut_ref.shape[2], body,
                            jnp.zeros((bq, d), jnp.float32))
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_fused_kernel(*refs, scale, blk, causal, has_kpm, has_bias,
+                      kpm_mode, bias_mode, precision):
+    """One-pass backward: dq, dk, dv from a single LUT-steered sweep.
+
+    The split kernels each recompute s, p and dO.V^T per (row, column)
+    block pair; this kernel computes them once, accumulating dk/dv into
+    full-length fp32 VMEM scratch indexed by the forward LUT's column
+    (a scatter — every listed pair is visited exactly once, so it covers
+    exactly what the transposed-LUT gather covered; invalid entries alias
+    column 0 but contribute exact zeros since their p and ds are zero).
+    Same structure as the dense flash fused backward
+    (ops/transformer/kernels/attention.py:_bwd_fused_kernel)."""
+    (q_ref, k_ref, v_ref, lut_ref, kpm_ref, bias_ref,
+     rest) = _unpack(refs, 3, has_kpm, has_bias)
+    do_ref, lse_ref, delta_ref = rest[:3]
+    dq_ref, dk_ref, dv_ref = rest[3:6]
+    dk_acc, dv_acc = rest[6:8]
+
+    i = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    bq, d = q.shape
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def body(j, dq):
+        col = lut_ref[0, 0, j]
+        valid = col >= 0
+        c = jnp.maximum(col, 0)
+        kv = pl.ds(c * blk, blk)
+        k_blk = k_ref[0, 0, kv].astype(jnp.float32)
+        v_blk = v_ref[0, 0, kv].astype(jnp.float32)
+        kpm_blk = kpm_ref[0, kv][None, :] if kpm_ref is not None else None
+        bias_blk = bias_ref[0, 0, :, kv] if bias_ref is not None else None
+        p, ds = _recompute_p_ds(q, do, lse, delta, k_blk, v_blk, kpm_blk,
+                                bias_blk, valid, i * bq, c, blk, scale,
+                                causal, kpm_mode, bias_mode, precision)
+        dv_acc[kv] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision)
+        dk_acc[kv] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision)
+        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32,
+                                        precision=precision)
+
+    dq = jax.lax.fori_loop(0, lut_ref.shape[2], body,
+                           jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+    @pl.when(i == n_q - 1)
+    def _emit():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _bwd_dkv_kernel(*refs, scale, blk, bq, causal, has_kpm, has_bias, kpm_mode,
@@ -245,25 +321,14 @@ def _bwd_dkv_kernel(*refs, scale, blk, bq, causal, has_kpm, has_bias, kpm_mode,
         do = do_ref[0, 0, pl.ds(r * bq, bq)].astype(jnp.float32)
         lse = lse_ref[0, 0, pl.ds(r * bq, bq)]
         delta = delta_ref[0, 0, pl.ds(r * bq, bq)]
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32,
-                                precision=precision) * scale
         bias_blk = (bias_ref[0, 0, pl.ds(r * bq, bq), :]
                     if bias_ref is not None else None)
-        s = _apply_masks(s, r * bq, jk, blk, kpm_blk, bias_blk, valid, causal,
-                         kpm_mode, bias_mode)
-        p = jnp.exp(s - lse)                               # [bq, blk]
+        p, ds = _recompute_p_ds(q, do, lse, delta, k_blk, v_blk, kpm_blk,
+                                bias_blk, valid, r * bq, jk, blk, scale,
+                                causal, kpm_mode, bias_mode, precision)
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32,
                                       precision=precision)
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32,
-                                 precision=precision)
-        ds = p * (dp - delta) * scale
-        if kpm_blk is not None and kpm_mode == 'mul':
-            ds = ds * kpm_blk
-        if bias_blk is not None and bias_mode == 'mul':
-            ds = ds * bias_blk
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32,
                                       precision=precision)
@@ -358,6 +423,29 @@ def _make_fn(fwd_lut, bwd_lut, blk, scale, causal, has_kpm, has_bias,
             args.append(bias.astype(jnp.float32))
         in_specs += [q_spec, row_blk, row_blk]
         args += [do, lse, delta]
+
+        if _bwd_mode(t, d, q.dtype) == "fused":
+            # One LUT-steered sweep produces dq and scatter-accumulates
+            # dk/dv into full-length fp32 scratch (same input layout as
+            # the dq kernel, so the spec/arg lists are shared).
+            from jax.experimental.pallas import tpu as pltpu
+
+            dq, dk, dv = pl.pallas_call(
+                functools.partial(_bwd_fused_kernel, scale=scale, blk=blk,
+                                  **flags),
+                grid=(b, h, t // blk),
+                in_specs=in_specs,
+                out_specs=[q_spec, full, full],
+                out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                           jax.ShapeDtypeStruct(k.shape, k.dtype),
+                           jax.ShapeDtypeStruct(v.shape, v.dtype)],
+                scratch_shapes=[pltpu.VMEM((t, d), jnp.float32),
+                                pltpu.VMEM((t, d), jnp.float32)],
+                interpret=_interpret(),
+            )(*args)
+            return _finish_bwd(q, k, v, kpm, bias, do, lse, delta,
+                               dq, dk, dv)
+
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, scale=scale, blk=blk, **flags),
             grid=(b, h, t // blk),
@@ -392,6 +480,11 @@ def _make_fn(fwd_lut, bwd_lut, blk, scale, causal, has_kpm, has_bias,
             interpret=_interpret(),
         )(*args)
 
+        return _finish_bwd(q, k, v, kpm, bias, do, lse, delta, dq, dk, dv)
+
+    def _finish_bwd(q, k, v, kpm, bias, do, lse, delta, dq, dk, dv):
+        """Shared tail of both backward paths: mask/bias cotangents."""
+        b, h, t, d = q.shape
         # The key-padding mask is an input mask, never a learned parameter:
         # its cotangent is defined as zero (documented non-differentiable).
         dkpm = None if kpm is None else jnp.zeros_like(kpm)

@@ -633,7 +633,9 @@ _FUSED_BWD_VMEM_BUDGET = int(os.environ.get(
 
 
 def _bwd_mode(t_kv, d, dtype):
-    """'fused' or 'split' — env DS_TPU_FLASH_BWD overrides the VMEM fit."""
+    """'fused' or 'split' — env DS_TPU_FLASH_BWD overrides the VMEM fit.
+    Governs both the dense flash backward and the block-sparse one
+    (ops/sparse_attention/kernels.py), which share the kernel structure."""
     mode = os.environ.get("DS_TPU_FLASH_BWD", "auto")
     if mode in ("fused", "split"):
         return mode

@@ -192,8 +192,14 @@ def test_kernel_forward_parity(name, cfg):
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize('mode', ['fused', 'split'])
 @pytest.mark.parametrize('name,cfg', CONFIGS[:3], ids=[c[0] for c in CONFIGS[:3]])
-def test_kernel_grad_parity(name, cfg):
+def test_kernel_grad_parity(monkeypatch, name, cfg, mode):
+    """Both backward paths (fused LUT-steered sweep vs split dq/dkv
+    kernels — DS_TPU_FLASH_BWD governs sparse too) must match the dense
+    oracle; auto would route these tiny shapes to fused and leave the
+    split kernels untested."""
+    monkeypatch.setenv('DS_TPU_FLASH_BWD', mode)
     q, k, v = make_qkv(b=1, h=4, t=64)
     layout = cfg.make_layout(64)
     causal = getattr(cfg, 'attention', None) == 'unidirectional'
@@ -205,6 +211,46 @@ def test_kernel_grad_parity(name, cfg):
     def loss_ref(q, k, v):
         return jnp.sum(block_sparse_attention_reference(
             q, k, v, layout, cfg.block, causal=causal) ** 2)
+
+    g = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize('mode', ['fused', 'split'])
+@pytest.mark.parametrize('kpm_mode,bias_mode', [('add', 'add'),
+                                                ('mul', 'mul')])
+def test_kernel_grad_parity_masked_biased(monkeypatch, mode, kpm_mode,
+                                          bias_mode):
+    """q/k/v gradients with a key-padding mask AND a learned bias, in both
+    mask modes, on both backward paths — the mul-mode ds scaling lives in
+    the kernels' inner loop and dbias alone would not catch a break
+    there."""
+    monkeypatch.setenv('DS_TPU_FLASH_BWD', mode)
+    q, k, v = make_qkv(b=2, h=4, t=64)
+    layout = FixedSparsityConfig(num_heads=4, block=16,
+                                 num_local_blocks=2).make_layout(64)
+    if kpm_mode == 'mul':
+        kpm = jnp.where(jnp.arange(64) < 48, 1.0, 0.0)[None, :].repeat(2, 0)
+    else:
+        kpm = jnp.where(jnp.arange(64) < 48, 0.0, -1e9)[None, :].repeat(2, 0)
+    rpe = jax.random.normal(jax.random.PRNGKey(9), (2, 4, 64, 64)) * 0.1
+    if bias_mode == 'mul':
+        rpe = 1.0 + jnp.abs(rpe)  # keep scores live in mul mode
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(block_sparse_attention(
+            q, k, v, layout, 16, key_padding_mask=kpm,
+            key_padding_mask_mode=kpm_mode, attn_bias=rpe,
+            attn_bias_mode=bias_mode) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(block_sparse_attention_reference(
+            q, k, v, layout, 16, key_padding_mask=kpm,
+            key_padding_mask_mode=kpm_mode, attn_bias=rpe,
+            attn_bias_mode=bias_mode) ** 2)
 
     g = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
