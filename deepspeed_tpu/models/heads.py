@@ -20,6 +20,7 @@ callers (eval) take the primal path and pay 1 GEMM, nothing eager.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -93,8 +94,40 @@ def _chunked_xe_total_bwd(dtype, res, g):
 _chunked_xe_total.defvjp(_chunked_xe_total_fwd, _chunked_xe_total_bwd)
 
 
+def _chunked_xe_total_remat(dtype, xc, w, lc, vc, bias_f):
+    """Remat'd 4-GEMM alternative: plain autodiff through checkpointed
+    chunks (forward logits + recomputed logits + dx + dW per chunk). One
+    more logit-sized GEMM than the eager path, but no fp32 [V, C] dW
+    accumulator carried through the forward scan — selectable via
+    DS_TPU_XE_HEAD=remat so the trade can be measured on hardware."""
+    @jax.checkpoint
+    def one(xi, li_, vi):
+        loss, _ = _chunk_loss(_logits(xi, w, bias_f, dtype), li_, vi)
+        return loss
+
+    def body(tot, args):
+        xi, li_, vi = args
+        return tot + one(xi, li_, vi), None
+
+    tot, _ = jax.lax.scan(body, jnp.float32(0.0), (xc, lc, vc))
+    return tot
+
+
+def _xe_head_impl(impl):
+    """Resolve the head implementation: the explicit ``impl`` argument
+    wins; otherwise DS_TPU_XE_HEAD, defaulting to 'eager'. The env is
+    read at trace time — a function jitted before the env changes keeps
+    its traced path (pass ``impl=`` explicitly when A/B-ing under jit)."""
+    impl = impl or os.environ.get("DS_TPU_XE_HEAD", "eager")
+    if impl not in ("eager", "remat"):
+        raise ValueError("unknown XE head impl {!r} (eager|remat)".format(
+            impl))
+    return impl
+
+
 def chunked_tied_softmax_xent(x, wte, labels, dtype, chunk=2048, bias=None,
-                              ignore_index=None, reduction="mean"):
+                              ignore_index=None, reduction="mean",
+                              impl=None):
     """Token cross-entropy against a tied [V, C] embedding decoder.
 
     Args:
@@ -109,6 +142,8 @@ def chunked_tied_softmax_xent(x, wte, labels, dtype, chunk=2048, bias=None,
         "sum_count" returns (sum, count) so a sequence-parallel caller can
         psum both before dividing (a local mean would weight shards with
         different supervised-token counts incorrectly).
+      impl: "eager" (3-GEMM custom_vjp, default) or "remat" (4-GEMM
+        autodiff); None defers to DS_TPU_XE_HEAD.
     Returns: scalar mean loss, or (loss_sum, token_count) fp32 scalars.
     """
     b, t, c = x.shape
@@ -134,7 +169,11 @@ def chunked_tied_softmax_xent(x, wte, labels, dtype, chunk=2048, bias=None,
     w = wte.astype(dtype)
     bias_f = bias.astype(jnp.float32) if bias is not None else None
 
-    total = _chunked_xe_total(jnp.dtype(dtype), xc, w, lc, vc, bias_f)
+    if _xe_head_impl(impl) == "remat":
+        total = _chunked_xe_total_remat(jnp.dtype(dtype), xc, w, lc, vc,
+                                        bias_f)
+    else:
+        total = _chunked_xe_total(jnp.dtype(dtype), xc, w, lc, vc, bias_f)
     count = jnp.sum(valid)
     if reduction == "sum_count":
         return total, count

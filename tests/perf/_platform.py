@@ -1,0 +1,25 @@
+"""Shared setup for the standalone perf scripts in this directory.
+
+Each script calls ``setup()`` before importing deepspeed_tpu:
+
+- puts the repo root on sys.path (``python tests/perf/x.py`` only gets
+  the script's own directory, which is also how this module resolves);
+- honors JAX_PLATFORMS=cpu in-process: sitecustomize pins jax_platforms
+  to the accelerator plugin at interpreter startup, so the env var alone
+  would still dial the relay (and hang on a held grant).
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def setup():
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

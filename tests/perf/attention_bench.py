@@ -20,8 +20,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__)))))
+import _platform
+
+_platform.setup()
 
 from deepspeed_tpu.ops.transformer.kernels.attention import (
     flash_attention, mha_reference)

@@ -18,14 +18,14 @@ Writes one JSON line per rung to stdout; stderr carries progress.
 
 import argparse
 import json
-import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__)))))
+import _platform
+
+_platform.setup()
 
 # (label, n_embd, n_layer) — params ~= 12*L*C^2 + 50257*C + pos
 RUNGS = [

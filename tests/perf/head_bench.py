@@ -1,20 +1,21 @@
 """Chunked tied-decoder XE head microbenchmark — fwd+bwd time at GPT-2
-shapes, vs the GEMM-bound ideal and a remat'd 4-GEMM variant.
+shapes, vs the GEMM-bound ideal, across head implementations and chunk
+sizes.
 
 Feeds the component table in docs/PERF.md. The round-3 head computes
 dx/dW eagerly in the forward chunk loop (3 logit-sized GEMMs per chunk,
-models/heads.py); the previous remat path recomputed logits in the
-backward (4 GEMMs). This bench measures both on the same shapes so a
-headline regression can be attributed (or cleared). Timing uses the same
-scan-in-jit + scalar-fetch pattern as attention_bench.py — on the
-tunneled dev TPU, block_until_ready was observed returning early.
+models/heads.py); DS_TPU_XE_HEAD=remat selects the 4-GEMM autodiff
+baseline. This bench times both on the same shapes (and a chunk-size
+sweep for the eager path) so a headline regression can be attributed.
+Timing uses the same scan-in-jit + scalar-fetch pattern as
+attention_bench.py — on the tunneled dev TPU, block_until_ready was
+observed returning early.
 
 Usage: python tests/perf/head_bench.py [--tokens 8192] [--embd 1024]
-       [--vocab 50257] [--chunk 2048]
+       [--vocab 50257] [--chunks 2048,4096,8192]
 """
 
 import argparse
-import os
 import sys
 import time
 
@@ -22,41 +23,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__)))))
+import _platform
+
+_platform.setup()
 
 from deepspeed_tpu.models.heads import chunked_tied_softmax_xent
 
 REPS = 10
 
 
-def remat_chunked_xe(x, wte, labels, dtype, chunk):
-    """The 4-GEMM baseline: plain autodiff through a remat'd chunk loop
-    (forward logits GEMM + recomputed logits GEMM + dx GEMM + dW GEMM)."""
-    n, c = x.shape
-    v = wte.shape[0]
-    n_chunks = n // chunk
-    xc = x.reshape(n_chunks, chunk, c)
-    lc = labels.reshape(n_chunks, chunk)
-
-    @jax.checkpoint
-    def one(xi, li):
-        logits = jax.lax.dot_general(
-            xi.astype(dtype), wte, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, li[:, None], axis=1)[:, 0]
-        return jnp.sum(lse - gold)
-
-    def body(tot, args):
-        xi, li = args
-        return tot + one(xi, li), None
-
-    tot, _ = jax.lax.scan(body, jnp.float32(0.0), (xc, lc))
-    return tot / n
-
-
-def time_fn(fn, x, wte, labels):
+def time_fn(fn, x, wte):
     eps = jnp.asarray(1e-7, x.dtype)
 
     def fwd_bwd(x, wte):
@@ -84,37 +60,45 @@ def main():
     ap.add_argument("--tokens", type=int, default=8192)
     ap.add_argument("--embd", type=int, default=1024)
     ap.add_argument("--vocab", type=int, default=50257)
-    ap.add_argument("--chunk", type=int, default=2048)
+    ap.add_argument("--chunks", default="2048,4096,8192")
     ap.add_argument("--dtype", default="bfloat16")
     args = ap.parse_args()
 
     n, c, v = args.tokens, args.embd, args.vocab
     dtype = jnp.dtype(args.dtype)
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.randn(n, c) * 0.02, dtype)
+    # The bench reshapes to the [B, T, C] form the real head takes.
+    x = jnp.asarray(rng.randn(1, n, c) * 0.02, dtype)
     wte = jnp.asarray(rng.randn(v, c) * 0.02, dtype)
-    labels = jnp.asarray(rng.randint(0, v, size=(n,)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, v, size=(1, n)), jnp.int32)
 
     peak = 197e12 if jax.default_backend() == "tpu" else 1e12
     gemm = 2 * n * c * v  # one logit-sized GEMM
-    ideal3 = 3 * gemm / peak
+
+    def run(impl, chunk):
+        return time_fn(
+            lambda x_, w_: chunked_tied_softmax_xent(
+                x_, w_, labels, dtype, chunk=chunk, impl=impl),
+            x, wte)
+
+    chunks = [int(s) for s in args.chunks.split(",") if s.strip()]
+    base = None
+    for chunk in chunks:
+        sec = run("eager", chunk)
+        if base is None:
+            base = sec
+        ideal = 3 * gemm / peak
+        print("head3  n{} c{} v{} chunk{} {}: {:.3f} ms  (3-GEMM ideal "
+              "{:.3f} ms, {:.1f}% of ideal)".format(
+                  n, c, v, chunk, dtype.name, sec * 1e3, ideal * 1e3,
+                  ideal / sec * 100), flush=True)
+
+    sec4 = run("remat", chunks[0])
     ideal4 = 4 * gemm / peak
-
-    sec = time_fn(
-        lambda x_, w_: chunked_tied_softmax_xent(
-            x_, w_, labels, dtype, chunk=args.chunk),
-        x, wte, labels)
-    print("head3  n{} c{} v{} chunk{} {}: {:.3f} ms  (3-GEMM ideal "
-          "{:.3f} ms, {:.1f}% of ideal)".format(
-              n, c, v, args.chunk, dtype.name, sec * 1e3, ideal3 * 1e3,
-              ideal3 / sec * 100))
-
-    sec4 = time_fn(
-        lambda x_, w_: remat_chunked_xe(x_, w_, labels, dtype, args.chunk),
-        x, wte, labels)
-    print("head4  remat baseline: {:.3f} ms  (4-GEMM ideal {:.3f} ms, "
-          "{:.1f}% of ideal; 3-GEMM speedup {:.2f}x)".format(
-              sec4 * 1e3, ideal4 * 1e3, ideal4 / sec4 * 100, sec4 / sec))
+    print("head4  remat chunk{}: {:.3f} ms  (4-GEMM ideal {:.3f} ms, "
+          "{:.1f}% of ideal; eager/chunk{} speedup {:.2f}x)".format(
+              chunks[0], sec4 * 1e3, ideal4 * 1e3, ideal4 / sec4 * 100,
+              chunks[0], sec4 / base), flush=True)
     return 0
 
 

@@ -47,14 +47,20 @@ def make_inputs(n_tokens=96, c=32, v=128, seed=0, ignore_frac=0.0):
     return x, wte, jnp.asarray(labels)
 
 
+@pytest.mark.parametrize("impl", ["eager", "remat"])
 @pytest.mark.parametrize("dtype,tol", [(jnp.float32, 2e-5),
                                        (jnp.bfloat16, 4e-2)])
 @pytest.mark.parametrize("chunk", [2048, 32, 40])  # single / multi / padded
-def test_loss_and_grads_match_dense(dtype, tol, chunk):
+def test_loss_and_grads_match_dense(dtype, tol, chunk, impl):
+    """Both head implementations (eager 3-GEMM custom_vjp, remat 4-GEMM
+    autodiff) must match the dense spec in loss AND grads, in both the
+    fp32 and bf16 regimes (the remat path's model-dtype dW accumulation
+    differs most from the eager fp32 accumulator in bf16)."""
     x, wte, labels = make_inputs()
 
     def ours(x, w):
-        return chunked_tied_softmax_xent(x, w, labels, dtype, chunk=chunk)
+        return chunked_tied_softmax_xent(x, w, labels, dtype, chunk=chunk,
+                                         impl=impl)
 
     def ref(x, w):
         return dense_reference(x, w, labels)
@@ -65,6 +71,19 @@ def test_loss_and_grads_match_dense(dtype, tol, chunk):
     for a, b in zip(go, gr):
         scale = max(1.0, float(jnp.abs(b).max()))
         assert float(jnp.abs(a.astype(jnp.float32) - b).max()) / scale < tol
+
+
+def test_head_impl_env_and_validation(monkeypatch):
+    """DS_TPU_XE_HEAD drives the default; explicit impl wins; junk
+    rejected."""
+    x, wte, labels = make_inputs(n_tokens=32)
+    monkeypatch.setenv("DS_TPU_XE_HEAD", "remat")
+    a = chunked_tied_softmax_xent(x, wte, labels, jnp.float32, chunk=32)
+    b = chunked_tied_softmax_xent(x, wte, labels, jnp.float32, chunk=32,
+                                  impl="eager")
+    assert abs(float(a) - float(b)) < 1e-5
+    with pytest.raises(ValueError):
+        chunked_tied_softmax_xent(x, wte, labels, jnp.float32, impl="nope")
 
 
 def test_ignore_index_and_bias_match_dense():
