@@ -240,7 +240,11 @@ def _emit(result):
     # flush: under the battery/supervisor stdout is a file; a later wedge
     # must not take this already-earned result line with it.
     print(json.dumps(result), flush=True)
-    if result["extra"].get("platform") == "tpu" and not fallback:
+    # A/B experiment runs (DS_BENCH_NO_RECORD=1, e.g. the battery's
+    # headline_remat/headline_splitbwd stages) must not overwrite the
+    # last-good artifact for the default configuration.
+    if result["extra"].get("platform") == "tpu" and not fallback and \
+            not os.environ.get("DS_BENCH_NO_RECORD"):
         _record_last_good(result)
 
 

@@ -66,6 +66,12 @@ STAGES = [
     ("smoke", ["-c", SMOKE], 1200, {}),
     ("headline", ["bench.py"], 2400,
      {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1"}),
+    ("headline_remat", ["bench.py"], 2400,
+     {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1",
+      "DS_BENCH_NO_RECORD": "1", "DS_TPU_XE_HEAD": "remat"}),
+    ("headline_splitbwd", ["bench.py"], 2400,
+     {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1",
+      "DS_BENCH_NO_RECORD": "1", "DS_TPU_FLASH_BWD": "split"}),
     ("attn", ["tests/perf/attention_bench.py", "--dense"], 2400, {}),
     ("attn_split", ["tests/perf/attention_bench.py", "--bwd", "split"],
      2400, {}),
