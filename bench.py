@@ -243,8 +243,10 @@ def _emit(result):
     # A/B experiment runs (DS_BENCH_NO_RECORD=1, e.g. the battery's
     # headline_remat/headline_splitbwd stages) must not overwrite the
     # last-good artifact for the default configuration.
+    no_record = os.environ.get("DS_BENCH_NO_RECORD", "0") \
+        not in ("0", "", "false")
     if result["extra"].get("platform") == "tpu" and not fallback and \
-            not os.environ.get("DS_BENCH_NO_RECORD"):
+            not no_record:
         _record_last_good(result)
 
 

@@ -59,6 +59,13 @@ def online_enabled():
     return os.environ.get("DS_TPU_AUTOTUNE", "0") not in ("0", "", "false")
 
 
+def force_enabled():
+    """DS_TPU_AUTOTUNE=force: re-sweep even for shapes already in a table
+    (used to refresh stale tables after a kernel redesign changes the
+    cost surface). Winners still land in the user cache."""
+    return os.environ.get("DS_TPU_AUTOTUNE", "") == "force"
+
+
 def _sync(out):
     """Execution barrier via a scalar VALUE fetch: on remote-device
     platforms block_until_ready can return before execution finishes, which
@@ -100,11 +107,18 @@ def autotune(kernel, signature, candidates, make_run, default, repeats=3):
     # per-host user cache (and per-host sweeps) could diverge across hosts
     # and compile different executables.
     tables = (bundled,) if multiproc else (user, bundled)
-    for table in tables:
-        if key in table:
-            chosen = table[key]["choice"]
-            _MEMO[key] = chosen
-            return chosen
+    # force mode only bypasses the tables when a sweep can ACTUALLY run
+    # here (eager call, runnable candidates, one controller, on-TPU);
+    # otherwise — e.g. the engine's traced calls under
+    # DS_TPU_AUTOTUNE=force — tuned tiles must still be served.
+    can_sweep = (platform == "tpu" and len(candidates) > 1
+                 and not multiproc)
+    if not (force_enabled() and can_sweep):
+        for table in tables:
+            if key in table:
+                chosen = table[key]["choice"]
+                _MEMO[key] = chosen
+                return chosen
     if not (online_enabled() and platform == "tpu" and len(candidates) > 1
             and not multiproc):
         if not online_enabled():

@@ -64,6 +64,7 @@ print("SMOKE PASS")
 # (name, argv-or-inline, timeout_s, env_extra)
 STAGES = [
     ("smoke", ["-c", SMOKE], 1200, {}),
+    ("autotune", ["tests/perf/autotune_sweep.py"], 3600, {}),
     ("headline", ["bench.py"], 2400,
      {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1"}),
     ("headline_remat", ["bench.py"], 2400,

@@ -1,0 +1,111 @@
+"""Unit tests for the per-shape kernel tile autotuner — table lookup
+order, memoization, and the force-resweep mode used to refresh stale
+tables after a kernel redesign (reference analogue: the cublas algo
+sweeps at layer creation, csrc/includes/gemm_test.h:27,141)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import autotuner
+
+
+@pytest.fixture()
+def tuner(monkeypatch):
+    monkeypatch.setattr(autotuner, "_MEMO", {})
+    monkeypatch.setattr(autotuner.jax, "process_count", lambda: 1)
+    monkeypatch.setattr(autotuner.jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("DS_TPU_AUTOTUNE", raising=False)
+    # Keep sweeps away from the real user cache file.
+    monkeypatch.setattr(autotuner, "_user_cache_path",
+                        lambda: "/nonexistent-dir/autotune.json")
+    return autotuner
+
+
+def _tables(monkeypatch, tuner, bundled=None, user=None):
+    monkeypatch.setattr(tuner, "_tables",
+                        lambda: (bundled or {}, user or {}))
+
+
+KEY = "tpu::flash_attention::sig1"
+
+
+def test_user_table_wins_over_bundled(tuner, monkeypatch):
+    _tables(monkeypatch, tuner,
+            bundled={KEY: {"choice": [1024, 1024]}},
+            user={KEY: {"choice": [512, 1024]}})
+    got = tuner.autotune("flash_attention", "sig1", [[256, 256]],
+                         make_run=None, default=[256, 256])
+    assert got == [512, 1024]
+
+
+def test_default_and_memo_when_tuning_off(tuner, monkeypatch):
+    _tables(monkeypatch, tuner)
+    calls = []
+
+    def make_run(cand):
+        calls.append(cand)
+        return lambda: np.zeros(1)
+
+    got = tuner.autotune("flash_attention", "sig1", [[1, 1], [2, 2]],
+                         make_run=make_run, default=[9, 9])
+    assert got == [9, 9] and not calls
+    assert tuner._MEMO[KEY] == [9, 9]
+
+
+def test_online_sweep_picks_fastest(tuner, monkeypatch):
+    monkeypatch.setenv("DS_TPU_AUTOTUNE", "1")
+    _tables(monkeypatch, tuner)
+    import time as _time
+
+    def make_run(cand):
+        def run():
+            _time.sleep(0.01 if cand == [1, 1] else 0.0)
+            return np.zeros(1)
+        return run
+
+    got = tuner.autotune("flash_attention", "sig1", [[1, 1], [2, 2]],
+                         make_run=make_run, default=[9, 9], repeats=1)
+    assert got == [2, 2]
+
+
+def test_force_resweeps_despite_table_entry(tuner, monkeypatch):
+    """DS_TPU_AUTOTUNE=force ignores stale table entries (a kernel
+    redesign changes the cost surface) and re-times candidates."""
+    monkeypatch.setenv("DS_TPU_AUTOTUNE", "force")
+    _tables(monkeypatch, tuner,
+            bundled={KEY: {"choice": [1024, 1024]}})
+    swept = []
+
+    def make_run(cand):
+        swept.append(cand)
+        return lambda: np.zeros(1)
+
+    got = tuner.autotune("flash_attention", "sig1", [[1, 1], [2, 2]],
+                         make_run=make_run, default=[9, 9], repeats=1)
+    assert swept  # the sweep actually ran
+    assert got in ([1, 1], [2, 2])
+
+
+def test_force_still_serves_table_to_traced_calls(tuner, monkeypatch):
+    """Under DS_TPU_AUTOTUNE=force a TRACED call (no runnable candidates —
+    the engine's jitted path) cannot sweep, so it must still get the
+    tuned table entry, not fall back to the default."""
+    monkeypatch.setenv("DS_TPU_AUTOTUNE", "force")
+    _tables(monkeypatch, tuner,
+            bundled={KEY: {"choice": [512, 1024]}})
+    got = tuner.autotune("flash_attention", "sig1", [],  # traced: no cands
+                         make_run=None, default=[9, 9])
+    assert got == [512, 1024]
+
+
+def test_multiproc_uses_bundled_only_and_ignores_force(tuner, monkeypatch):
+    """Multi-controller: every host must trace the same tiles, so only
+    the package-bundled table is consulted and force is ignored."""
+    monkeypatch.setenv("DS_TPU_AUTOTUNE", "force")
+    monkeypatch.setattr(tuner.jax, "process_count", lambda: 2)
+    _tables(monkeypatch, tuner,
+            bundled={KEY: {"choice": [1024, 1024]}},
+            user={KEY: {"choice": [512, 512]}})
+    got = tuner.autotune("flash_attention", "sig1", [[1, 1], [2, 2]],
+                         make_run=None, default=[9, 9])
+    assert got == [1024, 1024]
