@@ -632,6 +632,33 @@ _FUSED_BWD_VMEM_BUDGET = int(os.environ.get(
     "DS_TPU_FUSED_BWD_MAX_BYTES", 12 * 1024 * 1024))
 
 
+@functools.lru_cache(maxsize=None)
+def _fused_bwd_supported():
+    """One-time probe: does this backend compile the fused backward's
+    dynamic-offset VMEM scratch accumulation? On a Mosaic version that
+    rejects the pattern, 'auto' must degrade to the split kernels instead
+    of failing every training step. Concrete tiny-shape call, so it is
+    safe to run even while an outer trace is in progress; off-TPU
+    (interpret mode) the semantics are test-covered, return True."""
+    if jax.default_backend() != "tpu":
+        return True
+    try:
+        b, h, t, d = 1, 1, 256, 128
+        z = jnp.zeros((b, h, t, d), jnp.bfloat16)
+        row = jnp.zeros((b, h, t, 1), jnp.float32)
+        out = _flash_bwd_fused_pallas(z, z, z, None, row, row, z,
+                                      scale=1.0, causal=True,
+                                      block_q=128, block_k=128)
+        jax.block_until_ready(out)
+        return True
+    except Exception as e:  # compile/verification failure — not data
+        import warnings
+        warnings.warn("fused flash backward unsupported on this backend "
+                      "({}); auto mode falls back to the split kernels"
+                      .format(str(e)[:500]))
+        return False
+
+
 def _bwd_mode(t_kv, d, dtype):
     """'fused' or 'split' — env DS_TPU_FLASH_BWD overrides the VMEM fit.
     Governs both the dense flash backward and the block-sparse one
@@ -641,7 +668,9 @@ def _bwd_mode(t_kv, d, dtype):
         return mode
     itemsize = jnp.dtype(dtype).itemsize
     resident = t_kv * d * (4 * itemsize + 2 * 4)
-    return "fused" if resident <= _FUSED_BWD_VMEM_BUDGET else "split"
+    if resident > _FUSED_BWD_VMEM_BUDGET:
+        return "split"
+    return "fused" if _fused_bwd_supported() else "split"
 
 
 def _flash_bwd_fused_pallas(q, k, v, mask, delta, lse, do, scale, causal,
