@@ -31,7 +31,8 @@ import jax.numpy as jnp
 # Hashable shape/dtype subset of GPT2Config (the dataclass itself is
 # unhashable, and jit's static args must hash).
 _GenCfg = collections.namedtuple(
-    "_GenCfg", "n_layer n_head n_embd n_positions dtype")
+    "_GenCfg",
+    "n_layer n_head n_embd n_positions dtype layer_norm_epsilon")
 
 
 def init_cache(cfg, batch, max_len, dtype=None):
@@ -43,7 +44,7 @@ def init_cache(cfg, batch, max_len, dtype=None):
             "pos": jnp.zeros((), jnp.int32)}
 
 
-def _ln(x, p, eps=1e-6):
+def _ln(x, p, eps):
     x32 = x.astype(jnp.float32)
     mu = x32.mean(-1, keepdims=True)
     var = x32.var(-1, keepdims=True)
@@ -67,6 +68,7 @@ def _forward(params, cfg, ids, cache, last_only=False):
     pos0 = cache["pos"]
     max_len = cache["k"].shape[3]
 
+    eps = cfg.layer_norm_epsilon
     wte = params["wte"].astype(cfg.dtype)
     pe = jax.lax.dynamic_slice_in_dim(
         params["wpe"].astype(cfg.dtype), pos0, S, axis=0)
@@ -83,7 +85,7 @@ def _forward(params, cfg, ids, cache, last_only=False):
 
     for i in range(cfg.n_layer):
         blk = params["h_{}".format(i)]
-        h = _ln(x, blk["ln_1"])
+        h = _ln(x, blk["ln_1"], eps)
         qkv = _dense(h, blk["attn"]["c_attn"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
@@ -100,14 +102,14 @@ def _forward(params, cfg, ids, cache, last_only=False):
         y = jnp.einsum("bhqk,bhkd->bhqd", att, v_cache[i])
         y = y.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_embd)
         x = x + _dense(y, blk["attn"]["c_proj"])
-        h = _ln(x, blk["ln_2"])
+        h = _ln(x, blk["ln_2"], eps)
         h = _dense(h, blk["mlp"]["c_fc"])
         h = jax.nn.gelu(h, approximate=True)
         x = x + _dense(h, blk["mlp"]["c_proj"])
 
     if last_only:
         x = x[:, -1:]
-    x = _ln(x, params["ln_f"])
+    x = _ln(x, params["ln_f"], eps)
     logits = jnp.einsum("bsc,vc->bsv", x.astype(jnp.float32),
                         params["wte"].astype(jnp.float32))
     return logits, {"k": k_cache, "v": v_cache, "pos": pos0 + S}
@@ -162,7 +164,7 @@ def generate(model, params, prompt_ids, max_new_tokens, temperature=1.0,
     """
     cfg = getattr(model, "config", model)
     cfg = _GenCfg(cfg.n_layer, cfg.n_head, cfg.n_embd, cfg.n_positions,
-                  cfg.dtype)
+                  cfg.dtype, getattr(cfg, "layer_norm_epsilon", 1e-5))
     assert max_new_tokens >= 1
     if rng is None:
         rng = jax.random.PRNGKey(0)

@@ -31,6 +31,9 @@ class GPT2Config:
     n_layer: int = 12
     n_head: int = 12
     dropout: float = 0.1
+    # GPT-2's LayerNorm epsilon (HF layer_norm_epsilon; flax's default of
+    # 1e-6 costs ~1e-3 logits parity against reference checkpoints).
+    layer_norm_epsilon: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = False
     # Attention implementation: the Pallas flash kernel gives O(T) memory
@@ -155,9 +158,9 @@ class Block(nn.Module):
     def __call__(self, x, deterministic=True):
         cfg = self.config
         # Pre-LN transformer block (GPT-2 style).
-        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_1")(x)
         x = x + CausalSelfAttention(cfg, name="attn")(h, deterministic)
-        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_2")(x)
         x = x + MLP(cfg, name="mlp")(h, deterministic)
         return x
 
@@ -204,7 +207,7 @@ class GPT2LMHeadModel(nn.Module):
         for i in range(cfg.n_layer):
             x = block_cls(cfg, name="h_{}".format(i))(x, deterministic)
 
-        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_f")(x)
 
         if labels is None:
             # Tied LM head: logits in fp32 for a stable softmax-xent.

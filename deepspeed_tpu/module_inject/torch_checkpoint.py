@@ -1,0 +1,158 @@
+"""Import torch-format (reference DeepSpeed / HuggingFace) checkpoints.
+
+A user switching from the reference brings torch-serialized state:
+either a DeepSpeed save directory (``mp_rank_XX_model_states.pt`` files
+whose ``"module"`` entry is the torch ``state_dict()``, reference
+engine.py:1521-1554) or a bare HF model state dict. This module converts
+those into the flax param trees our models consume.
+
+GPT-2 mapping notes (HF ``transformers`` GPT2LMHeadModel):
+- our tree deliberately mirrors HF naming (wte, wpe, h_N/{ln_1, attn/
+  {c_attn, c_proj}, ln_2, mlp/{c_fc, c_proj}}, ln_f), so the map is
+  mostly mechanical;
+- HF uses Conv1D whose weight is stored [in, out] — the same layout as a
+  flax Dense kernel, so NO transpose (torch nn.Linear would need one);
+- LayerNorm ``weight`` becomes flax ``scale``;
+- ``lm_head.weight`` is tied to ``wte`` in both frameworks and is
+  dropped on import.
+"""
+
+import os
+import pickle
+import re
+
+import numpy as np
+
+__all__ = [
+    "load_torch_file",
+    "import_gpt2_state_dict",
+    "import_reference_checkpoint",
+]
+
+
+def load_torch_file(path):
+    """torch.load a checkpoint file and numpy-ify every tensor leaf.
+
+    Accepts both torch's zipfile serialization (torch.save) and this
+    repo's numpy-pickle files, so callers can point it at either
+    lineage's ``mp_rank_XX_model_states.pt``."""
+    try:
+        import torch
+    except ImportError:  # torch-less deployment: only our own files load
+        torch = None
+    if torch is not None:
+        try:
+            obj = torch.load(path, map_location="cpu", weights_only=False)
+            return _to_numpy(obj, torch)
+        except (pickle.UnpicklingError, RuntimeError, ValueError):
+            pass  # not a torch zipfile — fall through to plain pickle
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def _to_numpy(obj, torch):
+    if isinstance(obj, torch.Tensor):
+        return obj.detach().cpu().numpy()
+    if isinstance(obj, dict):
+        return {k: _to_numpy(v, torch) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy(v, torch) for v in obj)
+    return obj
+
+
+def _strip_prefixes(state_dict):
+    """Drop wrapper prefixes ('module.' from DDP-style wrapping,
+    'transformer.' from GPT2LMHeadModel) so keys start at wte/h.N/ln_f."""
+    out = {}
+    for key, val in state_dict.items():
+        for pre in ("module.", "transformer."):
+            if key.startswith(pre):
+                key = key[len(pre):]
+        out[key] = val
+    return out
+
+
+def import_gpt2_state_dict(state_dict, dtype=np.float32):
+    """HF-style GPT-2 torch ``state_dict`` -> flax params tree for
+    ``deepspeed_tpu.models.gpt2.GPT2LMHeadModel``.
+
+    Returns a nested dict ready for ``model.apply({"params": ...})``.
+    Raises KeyError on missing required entries (strict import — a
+    silent partial load trains from garbage)."""
+    sd = _strip_prefixes(state_dict)
+    params = {
+        "wte": np.asarray(sd["wte.weight"], dtype),
+        "wpe": np.asarray(sd["wpe.weight"], dtype),
+        "ln_f": {
+            "scale": np.asarray(sd["ln_f.weight"], dtype),
+            "bias": np.asarray(sd["ln_f.bias"], dtype),
+        },
+    }
+    layer_ids = sorted({
+        int(m.group(1))
+        for m in (re.match(r"h\.(\d+)\.", k) for k in sd)
+        if m
+    })
+    if not layer_ids:
+        raise KeyError("no transformer blocks (h.N.*) in state dict")
+    for i in layer_ids:
+        pre = "h.{}.".format(i)
+        params["h_{}".format(i)] = {
+            "ln_1": {
+                "scale": np.asarray(sd[pre + "ln_1.weight"], dtype),
+                "bias": np.asarray(sd[pre + "ln_1.bias"], dtype),
+            },
+            "attn": {
+                # HF Conv1D weight is [in, out] == flax Dense kernel.
+                "c_attn": {
+                    "kernel": np.asarray(sd[pre + "attn.c_attn.weight"],
+                                         dtype),
+                    "bias": np.asarray(sd[pre + "attn.c_attn.bias"], dtype),
+                },
+                "c_proj": {
+                    "kernel": np.asarray(sd[pre + "attn.c_proj.weight"],
+                                         dtype),
+                    "bias": np.asarray(sd[pre + "attn.c_proj.bias"], dtype),
+                },
+            },
+            "ln_2": {
+                "scale": np.asarray(sd[pre + "ln_2.weight"], dtype),
+                "bias": np.asarray(sd[pre + "ln_2.bias"], dtype),
+            },
+            "mlp": {
+                "c_fc": {
+                    "kernel": np.asarray(sd[pre + "mlp.c_fc.weight"], dtype),
+                    "bias": np.asarray(sd[pre + "mlp.c_fc.bias"], dtype),
+                },
+                "c_proj": {
+                    "kernel": np.asarray(sd[pre + "mlp.c_proj.weight"],
+                                         dtype),
+                    "bias": np.asarray(sd[pre + "mlp.c_proj.bias"], dtype),
+                },
+            },
+        }
+    return params
+
+
+def import_reference_checkpoint(load_dir, tag=None, mp_rank=0,
+                                importer=import_gpt2_state_dict,
+                                dtype=np.float32):
+    """Load a reference-DeepSpeed save directory into a flax params tree.
+
+    Reads ``latest`` when ``tag`` is None (reference engine.py:1293),
+    then ``<tag>/mp_rank_XX_model_states.pt`` and converts its
+    ``"module"`` state dict via ``importer``. Returns
+    (params, client_state) where client_state carries the non-module
+    checkpoint entries (global_steps, lr scheduler, ...)."""
+    if tag is None:
+        with open(os.path.join(load_dir, "latest")) as f:
+            tag = f.read().strip()
+    path = os.path.join(load_dir, tag,
+                        "mp_rank_{:02d}_model_states.pt".format(mp_rank))
+    ckpt = load_torch_file(path)
+    module = ckpt.get("module")
+    if module is None:
+        raise KeyError("{} has no 'module' entry".format(path))
+    params = importer(module, dtype=dtype)
+    client = {k: v for k, v in ckpt.items() if k != "module"}
+    return params, client

@@ -26,7 +26,7 @@ def make(dtype=jnp.float32, flash=False, seed=0):
 
 def gencfg(cfg):
     return _GenCfg(cfg.n_layer, cfg.n_head, cfg.n_embd, cfg.n_positions,
-                   cfg.dtype)
+                   cfg.dtype, cfg.layer_norm_epsilon)
 
 
 @pytest.mark.parametrize("flash", [False, True])
