@@ -2,4 +2,5 @@ from deepspeed_tpu.module_inject.replace_module import (
     pack_bert_layer, replace_attn_with_sparse, replace_module,
     replace_transformer_layer, revert_transformer_layer, unpack_bert_layer)
 from deepspeed_tpu.module_inject.torch_checkpoint import (
-    import_gpt2_state_dict, import_reference_checkpoint, load_torch_file)
+    import_bert_state_dict, import_gpt2_state_dict,
+    import_reference_checkpoint, load_torch_file)

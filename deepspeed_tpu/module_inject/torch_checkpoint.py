@@ -25,6 +25,7 @@ import numpy as np
 
 __all__ = [
     "load_torch_file",
+    "import_bert_state_dict",
     "import_gpt2_state_dict",
     "import_reference_checkpoint",
 ]
@@ -60,12 +61,13 @@ def _to_numpy(obj, torch):
     return obj
 
 
-def _strip_prefixes(state_dict):
+def _strip_prefixes(state_dict, prefixes=("module.", "transformer.")):
     """Drop wrapper prefixes ('module.' from DDP-style wrapping,
-    'transformer.' from GPT2LMHeadModel) so keys start at wte/h.N/ln_f."""
+    'transformer.' from GPT2LMHeadModel) so keys start at the model
+    root."""
     out = {}
     for key, val in state_dict.items():
-        for pre in ("module.", "transformer."):
+        for pre in prefixes:
             if key.startswith(pre):
                 key = key[len(pre):]
         out[key] = val
@@ -132,6 +134,82 @@ def import_gpt2_state_dict(state_dict, dtype=np.float32):
             },
         }
     return params
+
+
+def import_bert_state_dict(state_dict, dtype=np.float32):
+    """HF-style BERT torch ``state_dict`` (BertForPreTraining naming) ->
+    flax params tree for ``deepspeed_tpu.models.bert.BertForPreTraining``
+    with the FUSED encoder layout (use_fused_layer=True).
+
+    torch Linear weights are [out, in] — exactly the packed fused-layer
+    orientation (attn_qkvw = cat(q, k, v) along the out dim, reference
+    replace_module.py:23-57) — so encoder weights copy without transpose;
+    the flax Dense heads (pooler/transform/seq_relationship) DO
+    transpose. ``cls.predictions.decoder.weight`` is tied to the word
+    embeddings and dropped; ``cls.predictions.bias`` becomes mlm_bias."""
+    sd = _strip_prefixes(state_dict, prefixes=("module.",))
+
+    def arr(key):
+        return np.asarray(sd[key], dtype)
+
+    def linear_t(prefix):  # torch Linear -> flax Dense
+        return {"kernel": arr(prefix + ".weight").T,
+                "bias": arr(prefix + ".bias")}
+
+    bert = {
+        "embeddings": {
+            "word_embeddings": arr("bert.embeddings.word_embeddings.weight"),
+            "position_embeddings": arr(
+                "bert.embeddings.position_embeddings.weight"),
+            "token_type_embeddings": arr(
+                "bert.embeddings.token_type_embeddings.weight"),
+            "LayerNorm": {
+                "scale": arr("bert.embeddings.LayerNorm.weight"),
+                "bias": arr("bert.embeddings.LayerNorm.bias"),
+            },
+        },
+        "pooler": linear_t("bert.pooler.dense"),
+    }
+    layer_ids = sorted({
+        int(m.group(1))
+        for m in (re.match(r"bert\.encoder\.layer\.(\d+)\.", k) for k in sd)
+        if m
+    })
+    if not layer_ids:
+        raise KeyError("no encoder layers (bert.encoder.layer.N.*) in "
+                       "state dict")
+    for i in layer_ids:
+        pre = "bert.encoder.layer.{}.".format(i)
+        bert["layer_{}".format(i)] = {
+            "attn_qkvw": np.concatenate(
+                [arr(pre + "attention.self.query.weight"),
+                 arr(pre + "attention.self.key.weight"),
+                 arr(pre + "attention.self.value.weight")], axis=0),
+            "attn_qkvb": np.concatenate(
+                [arr(pre + "attention.self.query.bias"),
+                 arr(pre + "attention.self.key.bias"),
+                 arr(pre + "attention.self.value.bias")]),
+            "attn_ow": arr(pre + "attention.output.dense.weight"),
+            "attn_ob": arr(pre + "attention.output.dense.bias"),
+            "attn_nw": arr(pre + "attention.output.LayerNorm.weight"),
+            "attn_nb": arr(pre + "attention.output.LayerNorm.bias"),
+            "inter_w": arr(pre + "intermediate.dense.weight"),
+            "inter_b": arr(pre + "intermediate.dense.bias"),
+            "output_w": arr(pre + "output.dense.weight"),
+            "output_b": arr(pre + "output.dense.bias"),
+            "norm_w": arr(pre + "output.LayerNorm.weight"),
+            "norm_b": arr(pre + "output.LayerNorm.bias"),
+        }
+    return {
+        "bert": bert,
+        "transform": linear_t("cls.predictions.transform.dense"),
+        "transform_LayerNorm": {
+            "scale": arr("cls.predictions.transform.LayerNorm.weight"),
+            "bias": arr("cls.predictions.transform.LayerNorm.bias"),
+        },
+        "mlm_bias": arr("cls.predictions.bias"),
+        "seq_relationship": linear_t("cls.seq_relationship"),
+    }
 
 
 def import_reference_checkpoint(load_dir, tag=None, mp_rank=0,

@@ -86,3 +86,41 @@ def test_load_torch_file_reads_our_pickles(tmp_path):
 def test_strict_import_raises_on_missing_keys():
     with pytest.raises(KeyError):
         import_gpt2_state_dict({"wte.weight": np.zeros((8, 4))})
+
+
+def test_hf_bert_logits_parity():
+    """HF BertForPreTraining torch weights -> our fused-layer
+    BertForPreTraining: prediction and NSP logits must match."""
+    transformers = pytest.importorskip("transformers")
+    from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
+    from deepspeed_tpu.module_inject import import_bert_state_dict
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    hf = transformers.BertForPreTraining(hf_cfg)
+    hf.eval()
+
+    params = import_bert_state_dict(
+        {k: v.detach().numpy() for k, v in hf.state_dict().items()})
+    ours = BertForPreTraining(BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, dtype=jnp.float32))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, size=(2, 16))
+    mask = np.ones((2, 16), np.int64)
+    with torch.no_grad():
+        out = hf(torch.tensor(ids), attention_mask=torch.tensor(mask))
+    pred, nsp = ours.apply({"params": params}, jnp.asarray(ids),
+                           attention_mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(pred),
+                               out.prediction_logits.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(nsp),
+                               out.seq_relationship_logits.numpy(),
+                               rtol=2e-4, atol=2e-4)
