@@ -36,7 +36,30 @@ def op_report():
     return rows
 
 
+def _probe_backend(timeout=30):
+    """Backend info via a SUBPROCESS with a timeout: a wedged device
+    relay blocks jax.devices() forever (try/except cannot catch a hang),
+    and an environment report must never hang."""
+    import subprocess
+    import sys
+    code = ("import jax; d = jax.devices(); "
+            "print(jax.default_backend()); print(len(d)); "
+            "print(d[0].device_kind if d else 'none')")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return None, "probe timed out after {}s (wedged relay?)".format(
+            timeout)
+    if r.returncode != 0:
+        return None, (r.stderr or "").strip().splitlines()[-1:] or "error"
+    lines = r.stdout.strip().splitlines()
+    return lines, ""
+
+
 def version_report():
+    import os
+
     import jax
     import jaxlib
     print("DeepSpeed-TPU general environment info:")
@@ -48,15 +71,17 @@ def version_report():
         pass
     print("jax version ..............", jax.__version__)
     print("jaxlib version ...........", jaxlib.__version__)
-    try:
-        backend = jax.default_backend()
-        devices = jax.devices()
-        print("jax backend ..............", backend)
-        print("device count .............", len(devices))
-        print("device kind ..............",
-              devices[0].device_kind if devices else "none")
-    except Exception as e:  # no accelerator / no device grant
-        print("jax backend ..............", "unavailable ({})".format(e))
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        lines, err = (["cpu", str(jax.device_count()), "cpu"], "")
+    else:
+        lines, err = _probe_backend()
+    if lines:
+        print("jax backend ..............", lines[0])
+        print("device count .............", lines[1])
+        print("device kind ..............", lines[2])
+    else:
+        print("jax backend ..............", "unavailable ({})".format(err))
     try:
         import flax
         print("flax version .............", flax.__version__)
@@ -64,9 +89,31 @@ def version_report():
         print("flax version .............", "not installed")
 
 
+def tuning_report():
+    """Kernel-tuning knobs and table status (the reference's analogue is
+    the op compat matrix; these govern which TPU kernel paths run)."""
+    import json
+    import os
+    print("kernel tuning:")
+    print("flash backward path ......",
+          os.environ.get("DS_TPU_FLASH_BWD", "auto"))
+    print("xe head impl .............",
+          os.environ.get("DS_TPU_XE_HEAD", "eager"))
+    print("online autotune ..........",
+          os.environ.get("DS_TPU_AUTOTUNE", "0"))
+    try:
+        from deepspeed_tpu.ops import autotuner
+        with open(autotuner._BUNDLED_PATH) as f:
+            n = len(json.load(f))
+        print("autotune table entries ...", n)
+    except Exception:
+        print("autotune table entries ...", "none")
+
+
 def main():
     op_report()
     version_report()
+    tuning_report()
 
 
 def cli_main():
