@@ -453,7 +453,10 @@ def _measure_gpt2(batch, seq, steps):
         # Measured-best single-chip config (v5e): Pallas flash attention
         # (2.1x over dense XLA at T=1024 fwd+bwd); chunked-XE loss keeps
         # logits out of HBM so batch 8 fits without remat.
-        cfg = GPT2Config.gpt2_medium(dropout=0.0, use_flash_attention=True)
+        # n_positions follows the measured sequence: gpt2_medium's default
+        # (1024) would assert on the sweep's T=2048/4096 rows.
+        cfg = GPT2Config.gpt2_medium(dropout=0.0, use_flash_attention=True,
+                                     n_positions=max(1024, seq))
         peak_flops = PEAK_FLOPS_TPU
     else:
         cfg = GPT2Config.tiny(dropout=0.0)
