@@ -624,12 +624,15 @@ def _bwd_fused_kernel(*refs, scale, causal, block_q, block_k, has_mask,
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-# Per-(b, h) VMEM for the fused backward: k/v inputs + dk/dv outputs in
-# the model dtype, plus two full-length fp32 accumulators. Beyond the
-# budget (very long kv at large d) the split two-kernel path streams
-# blocks instead. Overridable for experiments.
+# Per-(b, h) VMEM for the fused backward's RESIDENT tensors: k/v inputs
+# + dk/dv outputs in the model dtype, plus two full-length fp32
+# accumulators. The budget is set well under the ~16 MB/core VMEM
+# because the loop's [block_q, block_k] fp32 score/prob intermediates
+# (~8 MB at 1024x1024 blocks) and pipeline double-buffering also live
+# there; beyond it the split two-kernel path streams blocks instead.
+# Overridable for experiments.
 _FUSED_BWD_VMEM_BUDGET = int(os.environ.get(
-    "DS_TPU_FUSED_BWD_MAX_BYTES", 12 * 1024 * 1024))
+    "DS_TPU_FUSED_BWD_MAX_BYTES", 6 * 1024 * 1024))
 
 
 @functools.lru_cache(maxsize=None)
