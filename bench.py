@@ -26,6 +26,30 @@ LAST_GOOD_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "docs", "last_good_tpu.json")
 
 
+def _git_state():
+    """Short commit hash of the measured code, '-dirty'-suffixed when the
+    working tree differs — stamped into every bench artifact so replayed
+    evidence (last_good_tpu) can be dated against the code it measured
+    (round-3 lesson: the headline was measured mid-session and the final
+    commits shipped unmeasured, invisibly)."""
+    cwd = os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                           capture_output=True, text=True, cwd=cwd,
+                           timeout=10)
+        if r.returncode != 0:
+            return None
+        head = r.stdout.strip()
+        d = subprocess.run(["git", "status", "--porcelain", "-uno"],
+                           capture_output=True, text=True, cwd=cwd,
+                           timeout=10)
+        if d.returncode == 0 and d.stdout.strip():
+            head += "-dirty"
+        return head
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+
+
 def _probe_once(timeout):
     """One subprocess attempt at backend init; (ok, reason)."""
     try:
@@ -208,6 +232,8 @@ def _record_last_good(result):
 # from the TPU metric it replaces).
 _FALLBACK_METRIC_FOR = {
     "gpt2_tiny_tokens_per_sec_per_chip": "gpt2_355m_tokens_per_sec_per_chip",
+    "gpt2_tiny_tokens_per_sec_per_chip_fp16":
+        "gpt2_355m_tokens_per_sec_per_chip_fp16",
     "gpt2_tiny_offload_smoke_tokens_per_sec":
         "gpt2_1.5b_offload_tokens_per_sec_per_chip",
     "gpt2_tiny_compute_tokens_per_sec_per_chip":
@@ -222,6 +248,7 @@ def _emit(result):
     and surface ITS vs_baseline as the headline ratio — the fallback
     exists to keep the harness alive through a wedged relay, not to
     report a 40x 'regression' that is really a dead tunnel."""
+    result["extra"].setdefault("git_hash", _git_state())
     fallback = os.environ.get("DS_BENCH_FALLBACK")
     if fallback:
         result["extra"]["fallback"] = fallback
@@ -232,9 +259,21 @@ def _emit(result):
             # Surface the last-good ratio as the headline so a wedge does
             # not read as a 40x regression — but label the substitution:
             # vs_baseline_source tells the reader this round measured
-            # nothing on TPU and the ratio is replayed evidence.
+            # nothing on TPU and the ratio is replayed evidence. When the
+            # replayed entry was measured on a DIFFERENT commit than the
+            # one running now, say so explicitly — replayed numbers must
+            # never pass as measurements of the current code.
             result["extra"]["last_good_tpu"] = last
-            result["extra"]["vs_baseline_source"] = "last_good_tpu"
+            measured_at = (last.get("extra") or {}).get("git_hash")
+            here = result["extra"]["git_hash"]
+            stale = bool(measured_at and here and measured_at != here)
+            result["extra"]["vs_baseline_source"] = (
+                "last_good_tpu (STALE: measured at {}, current {})".format(
+                    measured_at, here) if stale else "last_good_tpu")
+            result["extra"]["last_good_stale_hash"] = stale
+            if not stale and measured_at and "-dirty" in measured_at:
+                # Equal dirty hashes cannot prove equal code — say so.
+                result["extra"]["last_good_hash_dirty"] = True
             result["vs_baseline"] = last.get("vs_baseline",
                                              result["vs_baseline"])
     # flush: under the battery/supervisor stdout is a file; a later wedge
@@ -464,14 +503,20 @@ def _measure_gpt2(batch, seq, steps):
         peak_flops = 1e12
 
     model = GPT2LMHeadModel(cfg)
+    # DS_BENCH_FP16=1 prices the fp16 path (dynamic loss scaling + the
+    # kernels' unfused `dp - delta` fallback) at the headline shape —
+    # the battery's fp16 stage; default is the bf16 headline.
+    fp16 = os.environ.get("DS_BENCH_FP16", "0") not in ("0", "", "false")
+    precision_cfg = (
+        {"fp16": {"enabled": True, "initial_scale_power": 16}}
+        if fp16 else {"bf16": {"enabled": True}})
     engine, _, _, _ = deepspeed.initialize(
         model=model,
-        config_params={
+        config_params=dict({
             "train_batch_size": batch * jax.device_count(),
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-            "bf16": {"enabled": True},
             "zero_optimization": {"stage": 2} if jax.device_count() > 1 else {},
-        })
+        }, **precision_cfg))
 
     rng = np.random.RandomState(0)
     # Distinct batch per step, like a real input pipeline.
@@ -494,8 +539,8 @@ def _measure_gpt2(batch, seq, steps):
     mfu = tokens_per_sec_per_chip * flops_per_token(cfg, seq) / peak_flops
 
     return {
-        "metric": "gpt2_{}_tokens_per_sec_per_chip".format(
-            "355m" if on_tpu else "tiny"),
+        "metric": "gpt2_{}_tokens_per_sec_per_chip{}".format(
+            "355m" if on_tpu else "tiny", "_fp16" if fp16 else ""),
         "value": round(tokens_per_sec_per_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / REF_MFU, 4),
@@ -505,6 +550,7 @@ def _measure_gpt2(batch, seq, steps):
             "devices": jax.device_count(),
             "batch": batch,
             "seq": seq,
+            "precision": "fp16" if fp16 else "bf16",
             "loss": loss,
             "params": cfg.num_params(),
             "chunk_rates": chunk_rates,

@@ -52,7 +52,8 @@ def _probe_backend(timeout=30):
         return None, "probe timed out after {}s (wedged relay?)".format(
             timeout)
     if r.returncode != 0:
-        return None, (r.stderr or "").strip().splitlines()[-1:] or "error"
+        lines = (r.stderr or "").strip().splitlines()
+        return None, (lines[-1] if lines else "error")
     lines = r.stdout.strip().splitlines()
     return lines, ""
 

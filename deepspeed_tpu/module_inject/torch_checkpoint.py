@@ -42,11 +42,24 @@ def load_torch_file(path):
     except ImportError:  # torch-less deployment: only our own files load
         torch = None
     if torch is not None:
-        try:
+        import zipfile
+        if zipfile.is_zipfile(path):
+            # A torch zipfile that torch.load rejects is corrupt — let
+            # the original error surface instead of a confusing
+            # second-stage pickle error from the fallback.
             obj = torch.load(path, map_location="cpu", weights_only=False)
             return _to_numpy(obj, torch)
-        except (pickle.UnpicklingError, RuntimeError, ValueError):
-            pass  # not a torch zipfile — fall through to plain pickle
+        try:
+            # Legacy (pre-zipfile) torch serialization has no cheap
+            # magic check; attempt it, fall back to plain pickle.
+            obj = torch.load(path, map_location="cpu", weights_only=False)
+            return _to_numpy(obj, torch)
+        except (pickle.UnpicklingError, RuntimeError, ValueError) as torch_err:
+            try:
+                with open(path, "rb") as f:
+                    return pickle.load(f)
+            except Exception as e:
+                raise e from torch_err  # keep the torch error in the chain
     with open(path, "rb") as f:
         return pickle.load(f)
 

@@ -249,3 +249,23 @@ class UtilsBuilder(OpBuilder):
         cdll.ds_unflatten.argtypes = [pp, _c_long_p, ctypes.c_int, _c_float_p]
         cdll.ds_unflatten.restype = None
         return cdll
+
+    @staticmethod
+    def flatten_into(lib, dst, arrays):
+        """Pack contiguous fp32 ``arrays`` into ``dst`` back-to-back with
+        one OpenMP ds_flatten call. The ctypes marshaling lives here, next
+        to the argtypes, so the ABI is spelled out in exactly one module."""
+        srcs = (_c_float_p * len(arrays))(
+            *[a.ctypes.data_as(_c_float_p) for a in arrays])
+        sizes = (ctypes.c_long * len(arrays))(*[a.size for a in arrays])
+        lib.ds_flatten(srcs, sizes, len(arrays),
+                       dst.ctypes.data_as(_c_float_p))
+
+    @staticmethod
+    def unflatten_into(lib, dsts, src):
+        """Scatter ``src`` back into contiguous fp32 ``dsts`` spans."""
+        ptrs = (_c_float_p * len(dsts))(
+            *[a.ctypes.data_as(_c_float_p) for a in dsts])
+        sizes = (ctypes.c_long * len(dsts))(*[a.size for a in dsts])
+        lib.ds_unflatten(ptrs, sizes, len(dsts),
+                         src.ctypes.data_as(_c_float_p))

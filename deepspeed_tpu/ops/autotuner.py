@@ -84,6 +84,13 @@ def _time_candidate(run, repeats):
     return best
 
 
+def table_key(kernel, signature):
+    """The full table key for (current backend, kernel, signature) —
+    the single place the key format lives, so sweep/promotion scripts
+    (tests/perf/autotune_sweep.py) cannot drift from it."""
+    return "{}::{}::{}".format(jax.default_backend(), kernel, signature)
+
+
 def autotune(kernel, signature, candidates, make_run, default, repeats=3):
     """Pick the best candidate for (kernel, signature).
 
@@ -97,7 +104,7 @@ def autotune(kernel, signature, candidates, make_run, default, repeats=3):
     Returns: the chosen candidate.
     """
     platform = jax.default_backend()
-    key = "{}::{}::{}".format(platform, kernel, signature)
+    key = table_key(kernel, signature)
     if key in _MEMO:
         return _MEMO[key]
     multiproc = jax.process_count() > 1

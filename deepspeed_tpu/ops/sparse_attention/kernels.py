@@ -352,17 +352,17 @@ def _sparse_fused_supported():
     only. Off-TPU (interpret mode) the semantics are test-covered."""
     if jax.default_backend() != "tpu":
         return True
-    # Force the fused path for the probe itself: attend_bwd consults this
-    # function on the auto path, so probing through the public grad would
-    # otherwise recurse.
-    prev = os.environ.get("DS_TPU_FLASH_BWD")
-    os.environ["DS_TPU_FLASH_BWD"] = "fused"
+    # Force the fused path for the probe itself via _make_fn's force_bwd
+    # parameter: attend_bwd consults this function on the auto path, so
+    # probing through the public grad would otherwise recurse (and
+    # mutating the DS_TPU_FLASH_BWD env var here would leak the forced
+    # mode to concurrent traces on other threads).
     try:
         blk = 128
         layout = np.ones((1, 2, 2), np.int64)
         fwd_lut, bwd_lut = build_luts(layout)
         fn = _make_fn(fwd_lut, bwd_lut, blk, 1.0, False, False, False,
-                      'add', 'add', precision=None)
+                      'add', 'add', precision=None, force_bwd="fused")
         q = jnp.zeros((1, 1, 2 * blk, 128), jnp.bfloat16)
         g = jax.grad(lambda q_: jnp.sum(
             fn(q_, q, q, None, None).astype(jnp.float32)))(q)
@@ -374,11 +374,6 @@ def _sparse_fused_supported():
                       "({}); auto mode falls back to the split kernels"
                       .format(str(e)[:500]))
         return False
-    finally:
-        if prev is None:
-            os.environ.pop("DS_TPU_FLASH_BWD", None)
-        else:
-            os.environ["DS_TPU_FLASH_BWD"] = prev
 
 
 # ---------------------------------------------------------------------------
@@ -390,7 +385,10 @@ _FN_CACHE = {}
 
 
 def _make_fn(fwd_lut, bwd_lut, blk, scale, causal, has_kpm, has_bias,
-             kpm_mode, bias_mode, precision=None):
+             kpm_mode, bias_mode, precision=None, force_bwd=None):
+    # force_bwd pins the backward path ("fused"/"split") for this closure
+    # regardless of DS_TPU_FLASH_BWD / the support probe — used by
+    # _sparse_fused_supported so the probe never touches process state.
     # LUTs stay numpy in the closure; they are converted per call so that a
     # closure first built under a jit trace never caches tracer constants.
     fwd_lut = np.asarray(fwd_lut)
@@ -464,9 +462,13 @@ def _make_fn(fwd_lut, bwd_lut, blk, scale, causal, has_kpm, has_bias,
         in_specs += [q_spec, row_blk, row_blk]
         args += [do, lse, delta]
 
-        if _bwd_mode(t, d, q.dtype) == "fused" and (
+        if force_bwd:
+            use_fused = force_bwd == "fused"
+        else:
+            use_fused = _bwd_mode(t, d, q.dtype) == "fused" and (
                 os.environ.get("DS_TPU_FLASH_BWD") == "fused"
-                or _sparse_fused_supported()):
+                or _sparse_fused_supported())
+        if use_fused:
             # One LUT-steered sweep produces dq and scatter-accumulates
             # dk/dv into full-length fp32 scratch (same input layout as
             # the dq kernel, so the spec/arg lists are shared).

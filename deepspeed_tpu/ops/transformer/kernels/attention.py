@@ -1015,6 +1015,14 @@ def flash_attention_with_lse(q, k, v, mask=None, causal=False, scale=None,
                                 block_q, block_k)
 
 
+def flash_signature(b, h, t_q, t_kv, d, dtype, causal):
+    """Autotune-table signature for a flash-attention shape. Exported so
+    the sweep/promotion script (tests/perf/autotune_sweep.py) shares the
+    exact format and cannot silently drop entries if it changes."""
+    return "b{}_h{}_tq{}_tkv{}_d{}_{}_c{}".format(
+        b, h, t_q, t_kv, d, jnp.dtype(dtype).name, int(bool(causal)))
+
+
 def _autotuned_blocks(q, k, v, causal, default_q, default_k):
     """Per-shape tile selection via the autotuner (the reference sweeps
     cublas algos per shape at layer creation, gemm_test.h:27,141).
@@ -1031,8 +1039,7 @@ def _autotuned_blocks(q, k, v, causal, default_q, default_k):
 
     b, h, t_q, d = q.shape
     t_kv = k.shape[2]
-    sig = "b{}_h{}_tq{}_tkv{}_d{}_{}_c{}".format(
-        b, h, t_q, t_kv, d, q.dtype.name, int(bool(causal)))
+    sig = flash_signature(b, h, t_q, t_kv, d, q.dtype, causal)
     default = [min(default_q, t_q), min(default_k, t_kv)]
     traced = any(isinstance(x, jax.core.Tracer) for x in (q, k, v))
     if traced:

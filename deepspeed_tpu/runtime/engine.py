@@ -173,7 +173,27 @@ class DeepSpeedEngine(object):
         self._rng = jax.random.PRNGKey(seed)
 
         # Precision policy (fp32 master params always).
-        if self.fp16_enabled():
+        if self.amp_enabled():
+            # The reference hands `amp: {...}` to apex.amp.initialize
+            # (reference engine.py:569-575). The TPU-native cast policy
+            # that matches apex O1/O2 semantics — mixed-precision compute
+            # against fp32 master weights, no loss scaling required — is
+            # bf16 compute, which this engine already implements; amp maps
+            # onto it. Like the reference, amp is mutually exclusive with
+            # the explicit fp16/bf16 blocks.
+            if self.fp16_enabled() or self.bfloat16_enabled():
+                raise ValueError(
+                    "amp is mutually exclusive with the fp16/bf16 config "
+                    "blocks (reference semantics); enable exactly one")
+            opt_level = dict(self.amp_params() or {}).get("opt_level", "O1")
+            if opt_level not in ("O0", "O1", "O2", "O3"):
+                raise ValueError("unknown amp opt_level {!r}".format(opt_level))
+            log_dist("amp enabled (opt_level {}): mapped to the bf16 "
+                     "mixed-precision policy (bf16 compute, fp32 master "
+                     "params)".format(opt_level), ranks=[0])
+            self.compute_dtype = (jnp.float32 if opt_level == "O0"
+                                  else jnp.bfloat16)
+        elif self.fp16_enabled():
             self.compute_dtype = jnp.float16
         elif self.bfloat16_enabled():
             self.compute_dtype = jnp.bfloat16
@@ -1531,6 +1551,23 @@ class DeepSpeedEngine(object):
         self._offload_pre_fn = jax.jit(pre, donate_argnums=0)
         return self._offload_pre_fn
 
+    def _host_pack_lib(self):
+        """The host flatten/unflatten op (csrc/utils, ≙ reference
+        csrc/utils/flatten_unflatten.cpp used by engine/ZeRO bucketing):
+        packs a chunk's grad leaves into the contiguous staging buffer
+        with one OpenMP pass instead of a serial Python memcpy loop.
+        Returns None when the op cannot build (numpy fallback)."""
+        lib = getattr(self, "_host_pack_lib_cache", None)
+        if lib is None and not getattr(self, "_host_pack_failed", False):
+            try:
+                from deepspeed_tpu.op_builder import UtilsBuilder
+                lib = self._host_pack_lib_cache = UtilsBuilder().load()
+            except Exception as e:
+                self._host_pack_failed = True
+                logger.info("utils op unavailable (%s); offload staging "
+                            "uses the numpy pack loop", e)
+        return lib
+
     def _offload_chunks(self):
         """Group flat-buffer leaf indices into ~16 MB transfer chunks for the
         copy/compute/copy pipeline."""
@@ -1641,11 +1678,23 @@ class DeepSpeedEngine(object):
                     np.multiply(host_g, host_scale, out=host_g)
                 return host_g, lo, hi, time.time() - t0
             host_g = np.empty(hi - lo, np.float32)
+            # D2H wait + fp32 cast per leaf first; the pack into the
+            # contiguous staging buffer is then one OpenMP ds_flatten
+            # call (chunk offsets are consecutive, so cumulative-size
+            # packing lands each span at its flat-buffer offset).
+            host_leaves = []
             for i in chunk:
-                o, size = int(off["offsets"][i]), off["sizes"][i]
-                host_g[o - lo:o - lo + size] = np.asarray(
-                    g_leaves[i], dtype=np.float32).ravel()
+                host_leaves.append(np.ascontiguousarray(np.asarray(
+                    g_leaves[i], dtype=np.float32).ravel()))
                 g_leaves[i] = None  # free this grad leaf's HBM now
+            lib = self._host_pack_lib()
+            if lib is not None:
+                from deepspeed_tpu.op_builder import UtilsBuilder
+                UtilsBuilder.flatten_into(lib, host_g, host_leaves)
+            else:
+                for t, i in zip(host_leaves, chunk):
+                    o, size = int(off["offsets"][i]), off["sizes"][i]
+                    host_g[o - lo:o - lo + size] = t
             return host_g, lo, hi, time.time() - t0
 
         # Double-buffered staging: a single worker thread stages chunk i+1

@@ -31,7 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.ops import autotuner
-from deepspeed_tpu.ops.transformer.kernels.attention import flash_attention
+from deepspeed_tpu.ops.transformer.kernels.attention import (
+    flash_attention, flash_signature)
 
 # (batch, seq) grid — matches bench.py --sweep; heads/dim are GPT-2
 # medium's (the autotune signature keys on the full shape).
@@ -55,11 +56,12 @@ def main():
         # Eager call -> autotuner sweeps candidates and records the winner.
         out = flash_attention(q, k, v, causal=True)
         out.block_until_ready()
-        # The key the autotuner recorded for this shape (attention.py's
-        # signature format; causal, bf16).
-        swept_keys.append("{}::flash_attention::b{}_h{}_tq{}_tkv{}_d{}_"
-                          "bfloat16_c1".format(jax.default_backend(), b,
-                                               args.heads, t, t, args.dim))
+        # The key the autotuner recorded for this shape — built with the
+        # exported formatters so the key cannot drift from attention.py.
+        swept_keys.append(autotuner.table_key(
+            "flash_attention",
+            flash_signature(b, args.heads, t, t, args.dim,
+                            jnp.bfloat16, causal=True)))
         print("swept", spec, flush=True)
 
     user_path = autotuner._user_cache_path()

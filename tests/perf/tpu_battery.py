@@ -73,6 +73,9 @@ STAGES = [
     ("headline_splitbwd", ["bench.py"], 2400,
      {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1",
       "DS_BENCH_NO_RECORD": "1", "DS_TPU_FLASH_BWD": "split"}),
+    ("fp16", ["bench.py"], 2400,
+     {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1",
+      "DS_BENCH_FP16": "1"}),
     ("attn", ["tests/perf/attention_bench.py", "--dense"], 2400, {}),
     ("attn_split", ["tests/perf/attention_bench.py", "--bwd", "split"],
      2400, {}),

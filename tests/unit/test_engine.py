@@ -57,6 +57,37 @@ def test_bf16_loss_decreases():
     assert losses[-1] < losses[0]
 
 
+def test_amp_maps_to_bf16_policy():
+    """`amp: {enabled: true}` is the reference's apex hook (engine.py:
+    569-575); here it maps to the bf16 mixed-precision cast policy."""
+    import jax.numpy as jnp
+    model = SimpleModel(hidden_dim=16)
+    engine, _, _, _ = deepspeed.initialize(
+        model=model, config_params=base_config(amp={"enabled": True}))
+    assert engine.compute_dtype == jnp.bfloat16
+    assert engine.loss_scaler is None  # bf16 policy needs no scaling
+    losses = run_steps(engine, steps=20)
+    assert losses[-1] < losses[0]
+
+
+def test_amp_opt_level_o0_stays_fp32():
+    import jax.numpy as jnp
+    model = SimpleModel(hidden_dim=16)
+    engine, _, _, _ = deepspeed.initialize(
+        model=model,
+        config_params=base_config(amp={"enabled": True, "opt_level": "O0"}))
+    assert engine.compute_dtype == jnp.float32
+
+
+def test_amp_exclusive_with_fp16():
+    model = SimpleModel(hidden_dim=16)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        deepspeed.initialize(
+            model=model,
+            config_params=base_config(amp={"enabled": True},
+                                      fp16={"enabled": True}))
+
+
 def test_fp16_loss_scaling_runs():
     model = SimpleModel(hidden_dim=16)
     engine, _, _, _ = deepspeed.initialize(
