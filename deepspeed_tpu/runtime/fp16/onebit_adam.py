@@ -31,18 +31,34 @@ from deepspeed_tpu.runtime.custom_collectives import (
 from deepspeed_tpu.utils.logging import logger
 
 
-def init_onebit_adam_state(params, world_size=1):
+def init_onebit_adam_state(params, world_size=1, per_worker_rows=True):
     """Moments + step + per-leaf error-feedback buffers (sized to the padded
-    length, reference onebit_adam.py:295-309)."""
+    length, reference onebit_adam.py:295-309).
+
+    With ``world_size > 1`` and ``per_worker_rows`` the error buffers carry
+    ONE row per worker (worker_error [W, padded], server_error
+    [W, padded/W]): error feedback is per-rank state in the two-phase
+    exchange (reference keeps it in each rank's optimizer), and the engine
+    shards these leaves over the 'data' mesh axis so each worker owns its
+    row inside the shard_map hot path. ``per_worker_rows=False`` keeps the
+    single-row layout for configs where the exchange degenerates to
+    pre-averaged quantization (every row would stay identical — W× fp32
+    for nothing)."""
     zeros_like = lambda p: jnp.zeros(p.shape, dtype=jnp.float32)
+    rows = world_size if (world_size > 1 and per_worker_rows) else 1
 
     def worker_err(p):
         n = corrected_size(int(np.prod(p.shape)), world_size)
+        if rows > 1:
+            return jnp.zeros((rows, n), dtype=jnp.float32)
         return jnp.zeros((n,), dtype=jnp.float32)
 
     def server_err(p):
         n = corrected_size(int(np.prod(p.shape)), world_size)
-        return jnp.zeros((n // world_size,), dtype=jnp.float32)
+        if rows > 1:
+            return jnp.zeros((rows, n // world_size), dtype=jnp.float32)
+        return jnp.zeros((n // world_size,) if world_size > 1 else (n,),
+                         dtype=jnp.float32)
 
     tm = jax.tree_util.tree_map
     return {
@@ -81,6 +97,13 @@ def onebit_adam_update(params, grads, state, lr, beta1=0.9, beta2=0.999,
         g = g.astype(jnp.float32)
         p32 = p.astype(jnp.float32)
         n = int(np.prod(p.shape))
+        # Engine-layout error buffers carry one row per worker
+        # ([W, padded] / [W, padded/W], see init_onebit_adam_state). The
+        # shard_map hot path slices its own row before calling here; the
+        # degenerate pre-averaged path sees identical state on every
+        # worker, so row 0 is THE state — compute on it, broadcast back.
+        we_rows = werr.ndim == 2
+        we = werr[0] if we_rows else werr
 
         def warmup(_):
             m_new = beta1 * m + (1.0 - beta1) * g
@@ -89,14 +112,16 @@ def onebit_adam_update(params, grads, state, lr, beta1=0.9, beta2=0.999,
 
         def frozen_branch(_):
             m_loc = beta1 * m + (1.0 - beta1) * g
-            flat = jnp.zeros(werr.shape, jnp.float32).at[:n].set(
+            flat = jnp.zeros(we.shape, jnp.float32).at[:n].set(
                 m_loc.reshape(-1))
             if axis_name is not None:
-                avg, werr_new, serr_new = compressed_allreduce(
-                    flat, werr, serr, axis_name)
+                avg, we_new, serr_new = compressed_allreduce(
+                    flat, we, serr, axis_name)
             else:
-                avg, werr_new = quantize_error_feedback(flat, werr)
+                avg, we_new = quantize_error_feedback(flat, we)
                 serr_new = serr
+            werr_new = (jnp.broadcast_to(we_new, werr.shape)
+                        if we_rows else we_new)
             m_new = avg[:n].reshape(p.shape)
             return m_new, v, werr_new, serr_new
 
@@ -181,7 +206,15 @@ class OnebitAdam(object):
         self.state = {}
 
     def init_state(self, params):
-        return init_onebit_adam_state(params, self.world_size)
+        # Per-worker error rows only when the engine will run the shard_map
+        # hot path; on the degenerate (pre-averaged) paths every row would
+        # stay identical, wasting W× param-sized fp32.
+        rows = True
+        if self.deepspeed is not None:
+            eligible = getattr(self.deepspeed, "_onebit_spmd_eligible", None)
+            rows = bool(eligible()) if eligible is not None else False
+        return init_onebit_adam_state(params, self.world_size,
+                                      per_worker_rows=rows)
 
     def update(self, params, grads, state, lr=None, betas=None):
         group = self.param_groups[0]
@@ -211,8 +244,15 @@ class OnebitAdam(object):
     def state_dict(self):
         return {"param_groups": [
             {k: v for k, v in g.items() if k != "params"}
-            for g in self.param_groups]}
+            for g in self.param_groups],
+            "adam_freeze_key": self.adam_freeze_key}
 
     def load_state_dict(self, sd):
         for group, saved in zip(self.param_groups, sd.get("param_groups", [])):
             group.update(saved)
+        if sd.get("adam_freeze_key"):
+            # Restore the compression phase (and its side effect) so a
+            # resumed run selects the frozen program immediately.
+            self.adam_freeze_key = True
+            if self.deepspeed is not None:
+                self.deepspeed.enable_backward_allreduce = False

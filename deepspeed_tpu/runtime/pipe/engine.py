@@ -96,6 +96,8 @@ class PipelineEngine(DeepSpeedEngine):
         self.tied_param_owner = {}  # tied key -> first layer idx
         self.pipe_opt_state = None
         self._stage_fwd = {}  # stage_id -> jitted stage function
+        self._stage_fwd_bwd = {}  # stage_id -> (fwd+res jit, bwd jit)
+        self._opt_update_jit = None  # cached jitted per-layer update
         self._materialized = False
 
         self.grad_acc = [None] * len(self.layers)  # per-layer grad pytrees
@@ -234,12 +236,25 @@ class PipelineEngine(DeepSpeedEngine):
             return layer.apply({"params": params}, x, rngs={"dropout": rng})
         return layer(x)
 
+    def _onebit_spmd_eligible(self):
+        # The pipeline engine has its own per-layer optimizer path; the
+        # base engine's 1-bit shard_map hot path (and its per-worker
+        # error-row state layout) never applies here.
+        return False
+
     def _get_stage_fn(self, stage_id):
         """One jitted function running all of a stage's layers; last stage
         appends the loss_fn. Returns (out_or_loss, ...)."""
         if stage_id in self._stage_fwd:
             return self._stage_fwd[stage_id]
+        jitted = jax.jit(self._build_stage_fn(stage_id))
+        self._stage_fwd[stage_id] = jitted
+        return jitted
 
+    def _build_stage_fn(self, stage_id):
+        """The raw (unjitted) stage function — shared by the eval path
+        (_get_stage_fn jits it directly) and the training path
+        (_get_stage_fwd_bwd differentiates it under jit)."""
         start, stop = self.pipe_module.stage_layer_range(stage_id)
         layers = self.layers
         layer_params_idx = list(range(start, stop))
@@ -284,9 +299,48 @@ class PipelineEngine(DeepSpeedEngine):
                 return loss_fn(h, labels)
             return h
 
-        jitted = jax.jit(stage_fn)
-        self._stage_fwd[stage_id] = jitted
-        return jitted
+        return stage_fn
+
+    def _get_stage_fwd_bwd(self, stage_id):
+        """Pre-compiled (forward, backward) pair for the training path.
+
+        Calling ``jax.vjp`` eagerly per micro-batch re-traces the stage on
+        every ForwardPass (measured ~3 ms of host time per instruction on
+        tests/perf/pipe_dispatch_profile.py) and the returned closure then
+        executes the transposed jaxpr op-by-op on every BackwardPass —
+        host-bound dispatch that caps pipeline MFU. Instead both
+        directions are compiled ONCE per stage: the forward is the plain
+        stage jit, and the backward is a single program that recomputes
+        the stage forward and transposes it (``jax.vjp`` *inside* jit).
+
+        The recompute is deliberate, not a workaround: (a) the 1F1B
+        window keeps up to `stages` micro-batches in flight per stage, so
+        storing only the stage INPUT (instead of every vjp residual)
+        shrinks in-flight activation memory to one tensor per micro-batch
+        — the reason the reference defaults pipelines to activation
+        checkpointing too; (b) residual-passing via jax.closure_convert
+        cannot hoist integer-typed residuals (gather indices, dropout
+        bits), so it breaks on real losses/stages. Every instruction
+        after warmup is a cached-executable dispatch, letting the Python
+        interpreter run ahead of the devices (the overlap the schedule
+        needs; the reference hot loop pipe/engine.py:1146-1171 likewise
+        dispatches prebuilt kernels per instruction)."""
+        if stage_id in self._stage_fwd_bwd:
+            return self._stage_fwd_bwd[stage_id]
+        raw_fn = self._build_stage_fn(stage_id)
+        fwd = self._get_stage_fn(stage_id)
+
+        @jax.jit
+        def bwd(params_list, x, labels, rng, seed):
+            def f(ps, xx):
+                return raw_fn(ps, xx, labels, rng)
+
+            _, vjp = jax.vjp(f, params_list, x)
+            return vjp(seed)
+
+        pair = (fwd, bwd)
+        self._stage_fwd_bwd[stage_id] = pair
+        return pair
 
     # ----------------------------------------------------------- train_batch
 
@@ -455,15 +509,17 @@ class PipelineEngine(DeepSpeedEngine):
         labels = buf["labels"].get(cmd.buffer_id)
         start, stop = self.pipe_module.stage_layer_range(stage_id)
         params_list = [self.layer_params[i] for i in range(start, stop)]
-        fn = self._get_stage_fn(stage_id)
         rng = self._next_rng()
 
         if state["train"]:
-            out, vjp_fn = jax.vjp(
-                lambda ps, xx: fn(ps, xx, labels, rng), params_list, x)
-            buf["vjp"][cmd.buffer_id] = vjp_fn
+            fwd, _ = self._get_stage_fwd_bwd(stage_id)
+            out = fwd(params_list, x, labels, rng)
+            # Backward residual = the stage INPUTS (recompute-style): one
+            # tensor per in-flight micro-batch instead of every vjp
+            # intermediate — see _get_stage_fwd_bwd.
+            buf["vjp"][cmd.buffer_id] = (params_list, x, labels, rng)
         else:
-            out = fn(params_list, x, labels, rng)
+            out = self._get_stage_fn(stage_id)(params_list, x, labels, rng)
         buf["outputs"][cmd.buffer_id] = out
         if stage_id == self.num_stages - 1:
             # Reference semantics (pipe/engine.py:537-543): with a loss_fn the
@@ -479,7 +535,7 @@ class PipelineEngine(DeepSpeedEngine):
 
     def _exec_backward_pass(self, cmd, stage_id, state):
         buf = state["buffers"][stage_id]
-        vjp_fn = buf["vjp"].pop(cmd.buffer_id)
+        residuals = buf["vjp"].pop(cmd.buffer_id)
         if stage_id == self.num_stages - 1:
             seed = jnp.ones_like(buf["outputs"][cmd.buffer_id])
             # scale for mean over micro-batches (reference divides loss by gas)
@@ -492,7 +548,9 @@ class PipelineEngine(DeepSpeedEngine):
                                           seed.dtype)
         else:
             seed = buf["out_grad"].pop(cmd.buffer_id)
-        param_grads, in_grad = vjp_fn(seed)
+        _, bwd = self._get_stage_fwd_bwd(stage_id)
+        b_params, b_x, b_labels, b_rng = residuals
+        param_grads, in_grad = bwd(b_params, b_x, b_labels, b_rng, seed)
         buf["in_grad"][cmd.buffer_id] = in_grad
         start, stop = self.pipe_module.stage_layer_range(stage_id)
         for j, gi in enumerate(range(start, stop)):
@@ -612,9 +670,18 @@ class PipelineEngine(DeepSpeedEngine):
                 if spec.key in seen_tied:
                     continue
                 seen_tied.add(spec.key)
-            new_p, new_s = self.optimizer.update(
+            if self._opt_update_jit is None:
+                # Eager optimizer.update dispatches the Adam math op-by-op
+                # per layer (measured 0.3-1.0 s/step on the dispatch
+                # profile); one jitted wrapper compiles per layer-pytree
+                # structure and then every step is a cached dispatch.
+                opt = self.optimizer
+                self._opt_update_jit = jax.jit(
+                    lambda p, g, s, lr_, b1, b2: opt.update(
+                        p, g, s, lr=lr_, betas=(b1, b2)))
+            new_p, new_s = self._opt_update_jit(
                 params, self.grad_acc[i], self.pipe_opt_state[i],
-                lr=lr, betas=(beta1, beta2))
+                lr, jnp.float32(beta1), jnp.float32(beta2))
             self.layer_params[i] = new_p
             self.pipe_opt_state[i] = new_s
             # refresh the per-stage replicas of tied weights

@@ -254,6 +254,116 @@ def test_onebit_adam_convergence_vs_dense():
     assert np.abs(dense - target).mean() < np.abs(target).mean() * 0.5
 
 
+def _spmd_engine(freeze_step, lr=1e-2):
+    from deepspeed_tpu.models.simple import SimpleModel
+    engine, _, _, _ = deepspeed.initialize(
+        model=SimpleModel(hidden_dim=16),
+        config_params={
+            "train_batch_size": 16,
+            "optimizer": {"type": "OneBitAdam",
+                          "params": {"lr": lr, "freeze_step": freeze_step}},
+        })
+    return engine
+
+
+def test_onebit_engine_hot_path_compresses_the_wire(eight_devices):
+    """The ENGINE's train_batch compression phase must exchange sign-packed
+    uint8 (n/8 bytes + scales), not dense fp32 gradients (reference: 1-bit
+    Adam's 5x comm saving, README + custom_collectives igather/allgather).
+
+    Asserts on the compiled frozen program's collectives: the momentum
+    exchange is uint8 all_to_all/all_gather, and the ONLY f32 all_reduce
+    left is the scalar loss pmean — the dense gradient average is gone."""
+    engine = _spmd_engine(freeze_step=1)
+    assert engine._onebit_spmd_eligible()
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 16).astype(np.float32)
+    y = rng.randint(0, 16, size=(16,))
+    engine.train_batch(batch=(x, y))   # warmup step; freeze flips after
+    engine.train_batch(batch=(x, y))   # frozen program traces + runs
+    assert engine.optimizer.adam_freeze_key
+
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    inputs = mesh_lib.shard_batch(engine.mesh,
+                                  (jnp.asarray(x), jnp.asarray(y)))
+
+    def collectives(frozen):
+        fn = engine._fused_step_cache[("onebit", 2, frozen)]
+        hlo = fn.lower(engine.params, engine.opt_state, inputs,
+                       jax.random.PRNGKey(0), jnp.float32(1e-2),
+                       jnp.float32(0.9), jnp.float32(0.999)).as_text()
+        return {op: [l for l in hlo.splitlines() if "stablehlo." + op in l]
+                for op in ("all_to_all", "all_gather", "all_reduce")}
+
+    frozen = collectives(True)
+    # Phase-1 momentum scatter: uint8 on the wire, one per param leaf.
+    assert frozen["all_to_all"], "no all_to_all in the frozen program"
+    for line in frozen["all_to_all"]:
+        assert "ui8" in line, "momentum scatter is not sign-packed: " + line
+    # Phase-2 rebroadcast: uint8 chunks present among the gathers.
+    assert any("ui8" in l for l in frozen["all_gather"])
+    # f32 gathers may only carry the per-worker scales ([1] -> [W]).
+    for line in (l for l in frozen["all_gather"] if "f32" in l):
+        assert "tensor<1xf32>" in line, "dense f32 gather: " + line
+    # The ONLY all_reduce is the scalar loss pmean — no dense grad average.
+    assert len(frozen["all_reduce"]) == 1
+    # Contrast: the warmup program DOES carry dense f32 all_reduces (the
+    # explicit gradient pmean), proving the saving is phase-specific.
+    warmup = collectives(False)
+    assert len(warmup["all_reduce"]) > 1
+
+
+def test_onebit_engine_hot_path_loss_parity_with_dense_adam(eight_devices):
+    """Through and past the freeze boundary, the compressed engine path
+    tracks dense Adam (error feedback keeps the trajectory close on a
+    smooth objective; reference test strategy: convergence parity, not
+    bitwise equality)."""
+    from deepspeed_tpu.models.simple import SimpleModel
+    rng = np.random.RandomState(3)
+    x = rng.randn(16, 16).astype(np.float32)
+    y = rng.randint(0, 16, size=(16,))
+
+    def run(cfg_opt):
+        engine, _, _, _ = deepspeed.initialize(
+            model=SimpleModel(hidden_dim=16),
+            config_params={"train_batch_size": 16, "optimizer": cfg_opt})
+        return [float(engine.train_batch(batch=(x, y))) for _ in range(20)]
+
+    onebit = run({"type": "OneBitAdam",
+                  "params": {"lr": 1e-2, "freeze_step": 5}})
+    dense = run({"type": "Adam",
+                 "params": {"lr": 1e-2, "betas": [0.9, 0.999]}})
+    assert onebit[-1] < onebit[0]
+    # Same ballpark at the end of training (quantization noise allowed).
+    assert onebit[-1] < dense[-1] + 0.5 * abs(dense[0] - dense[-1])
+
+
+def test_onebit_resume_past_freeze_selects_frozen_program(
+        eight_devices, tmp_path):
+    """Checkpoint resume past freeze_step must run the FROZEN (compressed)
+    program from its first step — the host flag is restored from the
+    checkpointed counters, not left at its warmup default."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 16).astype(np.float32)
+    y = rng.randint(0, 16, size=(16,))
+    engine = _spmd_engine(freeze_step=2)
+    for _ in range(4):
+        engine.train_batch(batch=(x, y))
+    assert engine.optimizer.adam_freeze_key
+    engine.save_checkpoint(str(tmp_path))
+
+    fresh = _spmd_engine(freeze_step=2)
+    fresh.load_checkpoint(str(tmp_path))
+    assert fresh.optimizer.adam_freeze_key, \
+        "freeze flag not restored on resume"
+    assert not fresh.enable_backward_allreduce
+    fresh.train_batch(batch=(x, y))
+    keys = list(fresh._fused_step_cache)
+    assert ("onebit", 2, True) in keys, keys
+    assert ("onebit", 2, False) not in keys, \
+        "resume ran a warmup-phase step past freeze: {}".format(keys)
+
+
 def test_onebit_update_shard_map_local_grads(eight_devices):
     """The shard_map path: per-worker local grads, momentum exchanged via the
     two-phase compressed collective; resulting params identical on all
