@@ -250,9 +250,13 @@ class OnebitAdam(object):
     def load_state_dict(self, sd):
         for group, saved in zip(self.param_groups, sd.get("param_groups", [])):
             group.update(saved)
-        if sd.get("adam_freeze_key"):
-            # Restore the compression phase (and its side effect) so a
-            # resumed run selects the frozen program immediately.
-            self.adam_freeze_key = True
+        if "adam_freeze_key" in sd:
+            # Restore the phase BOTH ways: a resume past freeze selects
+            # the frozen program immediately, and a rollback to a
+            # pre-freeze checkpoint re-enters warmup (clearing the flag
+            # and re-enabling the dense allreduce) instead of staying
+            # stuck in compression with a warmup-era exp_avg_sq.
+            self.adam_freeze_key = bool(sd["adam_freeze_key"])
             if self.deepspeed is not None:
-                self.deepspeed.enable_backward_allreduce = False
+                self.deepspeed.enable_backward_allreduce = \
+                    not self.adam_freeze_key

@@ -82,6 +82,7 @@ STAGES = [
     ("attn2048", ["tests/perf/attention_bench.py", "--seq", "2048",
                   "--batch", "4", "--dense"], 2400, {}),
     ("head", ["tests/perf/head_bench.py"], 2400, {}),
+    ("pipe", ["tests/perf/pipe_bench.py"], 2400, {}),
     ("sweep", ["bench.py", "--sweep"], 4200,
      {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1"}),
     ("xl_compute", ["bench.py", "--xl-compute"], 2400,

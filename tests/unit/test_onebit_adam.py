@@ -364,6 +364,28 @@ def test_onebit_resume_past_freeze_selects_frozen_program(
         "resume ran a warmup-phase step past freeze: {}".format(keys)
 
 
+def test_onebit_rollback_to_prefreeze_reenters_warmup(
+        eight_devices, tmp_path):
+    """Rolling an engine already past freeze back to a PRE-freeze
+    checkpoint must clear the compression phase (and re-enable the dense
+    allreduce), not stay frozen with a warmup-era exp_avg_sq."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(16, 16).astype(np.float32)
+    y = rng.randint(0, 16, size=(16,))
+    engine = _spmd_engine(freeze_step=10)
+    engine.train_batch(batch=(x, y))  # 1 warmup step
+    engine.save_checkpoint(str(tmp_path))  # pre-freeze checkpoint
+
+    engine2 = _spmd_engine(freeze_step=2)
+    for _ in range(4):
+        engine2.train_batch(batch=(x, y))
+    assert engine2.optimizer.adam_freeze_key  # frozen now
+    engine2.optimizer.freeze_step = 10  # same schedule as the checkpoint
+    engine2.load_checkpoint(str(tmp_path))
+    assert not engine2.optimizer.adam_freeze_key, "rollback stayed frozen"
+    assert engine2.enable_backward_allreduce
+
+
 def test_onebit_update_shard_map_local_grads(eight_devices):
     """The shard_map path: per-worker local grads, momentum exchanged via the
     two-phase compressed collective; resulting params identical on all
