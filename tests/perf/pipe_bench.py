@@ -14,11 +14,11 @@ TPU (24 x d1024 blocks at T=1024) and shrink off-TPU.
 import json
 import time
 
+import _platform
+
+_platform.setup()
+
 import jax
-
-if jax.default_backend() not in ("cpu", "tpu"):
-    jax.config.update("jax_platforms", "cpu")
-
 import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
@@ -47,9 +47,13 @@ class BlockStack(nn.Module):
 
 
 def measure(fn, steps, tokens_per_step, warmup=2):
+    out = None
     for _ in range(warmup):
         out = fn()
-    jax.block_until_ready(out)
+    # Scalar fetch, not block_until_ready: on the tunneled dev TPU the
+    # latter was observed returning early, which would bleed warmup and
+    # first-call compile into the timed window.
+    float(np.asarray(jax.device_get(out)).ravel()[0])
     t0 = time.perf_counter()
     last = None
     for _ in range(steps):
