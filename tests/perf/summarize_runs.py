@@ -1,0 +1,84 @@
+"""Summarize .tpu_runs/ battery artifacts into one table.
+
+Each battery stage writes its stdout to .tpu_runs/<stage>.out; bench-family
+stages emit one JSON line (sometimes preceded by log noise). This reads
+every .out, pulls the last parseable JSON object, and prints
+stage | metric | value | unit | mfu/ratio | git_hash — the round's
+evidence at a glance (for PERF.md and the round log).
+
+Usage: python tests/perf/summarize_runs.py [--runs DIR]
+"""
+
+import argparse
+import json
+import os
+
+
+def last_json(path):
+    best = None
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not (line.startswith("{") and line.endswith("}")):
+                    continue
+                try:
+                    best = json.loads(line)
+                except ValueError:
+                    continue
+    except OSError:
+        return None
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--runs", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), ".tpu_runs"))
+    args = ap.parse_args()
+
+    rows = []
+    try:
+        names = sorted(os.listdir(args.runs))
+    except OSError:
+        print("no parseable artifacts in", args.runs)
+        return
+    for name in names:
+        if not name.endswith(".out"):
+            continue
+        stage = name[:-4]
+        if ".fail" in stage:
+            # Failed-attempt archives (<stage>.failN.out) are kept as
+            # debugging evidence, not results — a partial JSON line from
+            # an aborted run must not read as a passing number.
+            continue
+        r = last_json(os.path.join(args.runs, name))
+        if not isinstance(r, dict):
+            continue
+        extra = r.get("extra") or {}
+        aux = extra.get("mfu")
+        if aux is None:
+            aux = r.get("heavy_handler_fraction")
+        rows.append((stage,
+                     str(r.get("metric", "?")),
+                     str(r.get("value", "?")),
+                     str(r.get("unit", "")),
+                     "" if aux is None else str(aux),
+                     str(extra.get("platform", "")),
+                     str(extra.get("git_hash", ""))))
+
+    if not rows:
+        print("no parseable artifacts in", args.runs)
+        return
+    headers = ("stage", "metric", "value", "unit", "mfu", "plat", "git")
+    widths = [max(len(headers[i]), max(len(r[i]) for r in rows))
+              for i in range(len(headers))]
+    fmt = "  ".join("{:<%d}" % w for w in widths)
+    print(fmt.format(*headers))
+    for r in rows:
+        print(fmt.format(*r))
+
+
+if __name__ == "__main__":
+    main()
