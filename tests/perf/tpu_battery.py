@@ -118,6 +118,20 @@ def probe(timeout=180):
         return False
 
 
+def _write_status(**fields):
+    """Machine-readable heartbeat (.tpu_runs/status.json): the round-3
+    battery failed 36+ probes with evidence only in a human log; this
+    artifact lets the driver (or a later session) see at a glance
+    whether the chip ever answered and what is still pending."""
+    fields["updated_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    try:
+        with open(os.path.join(RUNS, "status.json"), "w") as f:
+            json.dump(fields, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pass
+
+
 def wait_for_chip(deadline):
     backoff = 30
     attempt = 0
@@ -125,8 +139,12 @@ def wait_for_chip(deadline):
         attempt += 1
         if probe():
             log("probe ok (attempt {})".format(attempt))
+            _write_status(chip="up", consecutive_failed_probes=0)
             return True
         log("probe {} failed; retry in {}s".format(attempt, backoff))
+        _write_status(chip="down", consecutive_failed_probes=attempt,
+                      next_retry_s=backoff,
+                      budget_left_s=int(max(0, deadline - time.time())))
         time.sleep(min(backoff, max(0, deadline - time.time())))
         backoff = min(int(backoff * 1.5), 300)
     return False
@@ -231,6 +249,11 @@ def main():
                 env_extra)
             with open(results_path, "w") as f:
                 json.dump(results, f, indent=1)
+            _write_status(
+                chip="up", last_stage=name, last_stage_ok=results[name],
+                passed=[k for k, v in results.items() if v],
+                pending=[s[0] for s in STAGES if s[0] in want
+                         and not results.get(s[0])])
     log("battery complete: {}".format(results))
     return 0 if results and all(
         results.get(n) for n in want) else 1
