@@ -238,6 +238,10 @@ _FALLBACK_METRIC_FOR = {
         "gpt2_1.5b_offload_tokens_per_sec_per_chip",
     "gpt2_tiny_compute_tokens_per_sec_per_chip":
         "gpt2_1.5b_compute_tokens_per_sec_per_chip",
+    "bert_tiny_tokens_per_sec_per_chip":
+        "bert_large_tokens_per_sec_per_chip",
+    "bert_tiny_sparse_tokens_per_sec_per_chip":
+        "bert_large_sparse_tokens_per_sec_per_chip",
 }
 
 
@@ -558,6 +562,113 @@ def _measure_gpt2(batch, seq, steps):
     }
 
 
+def _measure_bert(sparse, steps):
+    """BERT-large MLM+NSP training throughput — the reference's own record
+    config family (BASELINE.md: 66 TFLOPS/V100 = 52% of peak on BERT-large;
+    docs/_posts/2020-05-19-bert-record.md:14). Dense mode runs the fused
+    layer (flash attention) at T=512; sparse mode runs the plain encoder
+    with the block-sparse Pallas kernel at T=4096 (the reference's sparse
+    attention is its long-sequence story, README.md:17)."""
+    import jax
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        if sparse:
+            from deepspeed_tpu.ops.sparse_attention import (
+                FixedSparsityConfig)
+            seq, batch = 4096, 2
+            cfg = BertConfig.bert_large(
+                max_position_embeddings=seq, use_fused_layer=False,
+                sparse_attention_config=FixedSparsityConfig(
+                    num_heads=16, block=64, attention="bidirectional"),
+                hidden_dropout_prob=0.0,
+                attention_probs_dropout_prob=0.0)
+        else:
+            seq, batch = 512, 16
+            cfg = BertConfig.bert_large(hidden_dropout_prob=0.0,
+                                        attention_probs_dropout_prob=0.0)
+        peak_flops = PEAK_FLOPS_TPU
+    else:
+        seq, batch = 128, 4
+        kw = {}
+        if sparse:
+            from deepspeed_tpu.ops.sparse_attention import (
+                FixedSparsityConfig)
+            kw = dict(use_fused_layer=False,
+                      sparse_attention_config=FixedSparsityConfig(
+                          num_heads=4, block=32,
+                          attention="bidirectional"))
+        cfg = BertConfig.tiny(max_position_embeddings=seq,
+                              hidden_dropout_prob=0.0,
+                              attention_probs_dropout_prob=0.0, **kw)
+        peak_flops = 1e12
+    if cfg.sparse_attention_config is not None:
+        layout = np.asarray(cfg.sparse_attention_config.make_layout(seq))
+        density = float(layout.sum()) / layout.size
+    else:
+        density = 1.0
+
+    engine, _, _, _ = deepspeed.initialize(
+        model=BertForPreTraining(cfg),
+        config_params={
+            "train_batch_size": batch * jax.device_count(),
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": True},
+        })
+
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        ids = rng.randint(0, cfg.vocab_size, size=(batch, seq))
+        labels = np.where(rng.rand(batch, seq) < 0.15, ids, -1)
+        nsp = rng.randint(0, 2, size=(batch,))
+        return (ids, np.ones_like(ids), np.zeros_like(ids), labels, nsp)
+
+    batches = [make_batch() for _ in range(steps + 1)]
+    loss = engine.train_batch(batch=batches[0])
+    float(loss)  # compile barrier
+
+    chunk_rates, loss = _timed_chunks(
+        lambda b: engine.train_batch(batch=b), batches[1:],
+        chunk=4, tokens_per_step=batch * seq, label="bert")
+    tok = max(chunk_rates)
+
+    n_params = int(sum(int(np.prod(l.shape)) for l in
+                       jax.tree_util.tree_leaves(engine.params)))
+    # 6*N dense matmul FLOPs/token + non-causal attention score/value
+    # matmuls (4TC per layer fwd, x3 fwd+bwd = 12TC), density-scaled for
+    # the block-sparse layout.
+    attn = 12 * cfg.num_hidden_layers * seq * cfg.hidden_size * density
+    mfu = tok * (6 * n_params + attn) / peak_flops
+
+    _emit({
+        "metric": "bert_{}{}_tokens_per_sec_per_chip".format(
+            "large" if on_tpu else "tiny", "_sparse" if sparse else ""),
+        "value": round(tok, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / REF_MFU, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "platform": platform,
+            "batch": batch,
+            "seq": seq,
+            "params": n_params,
+            "loss": loss,
+            "attention_density": round(density, 4),
+            "chunk_rates": chunk_rates,
+        },
+    })
+
+
+def main_bert(sparse=False):
+    _require_tpu_or_exit()
+    _measure_bert(sparse=sparse, steps=12)
+
+
 def main():
     _require_tpu_or_exit()
     _emit(_measure_gpt2(batch=8, seq=1024, steps=20))
@@ -593,6 +704,10 @@ def _dispatch(argv):
         return main_xl_compute()
     if "--xl" in argv:
         return main_xl()
+    if "--bert-sparse" in argv:
+        return main_bert(sparse=True)
+    if "--bert" in argv:
+        return main_bert()
     return main()
 
 

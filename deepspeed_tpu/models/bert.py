@@ -47,6 +47,12 @@ class BertConfig:
     sequence_parallel_axis: Any = None
     # "ring" or "ulysses" (see GPT2Config.sequence_parallel_mode).
     sequence_parallel_mode: str = "ring"
+    # A SparsityConfig (ops/sparse_attention/sparsity_config.py) routes the
+    # plain encoder's attention through the block-sparse Pallas kernel —
+    # the model-level form of the reference's
+    # replace_model_self_attention_with_sparse_self_attention swap
+    # (sparse_attention_utils.py:85-121). Requires use_fused_layer=False.
+    sparse_attention_config: Any = None
 
     @classmethod
     def bert_base(cls, **kw):
@@ -159,7 +165,18 @@ class PlainBertLayer(nn.Module):
         k = heads(nn.Dense(h, dtype=cfg.dtype, name="key")(x))
         v = heads(nn.Dense(h, dtype=cfg.dtype, name="value")(x))
         sp = _sp_axis(cfg)
-        if sp is not None:
+        if cfg.sparse_attention_config is not None:
+            # Block-sparse Pallas attention (the reference's sparse-BERT
+            # long-sequence path); probs never materialize, so the
+            # attention dropout rides the context output.
+            from deepspeed_tpu.ops.sparse_attention import (
+                SparseSelfAttention)
+            ctx = SparseSelfAttention(
+                sparsity_config=cfg.sparse_attention_config,
+                name="sparse_attn")(q, k, v, key_padding_mask=add_mask)
+            ctx = nn.Dropout(cfg.attention_probs_dropout_prob)(
+                ctx, deterministic=deterministic)
+        elif sp is not None:
             # Token-sharded: attend globally via the k/v ring (local
             # key-padding mask rotates with its block) or Ulysses
             # all-to-all head swaps. Attention-prob dropout moves to the
@@ -218,6 +235,14 @@ class BertModel(nn.Module):
             raise ValueError(
                 "sequence_parallel BERT requires use_fused_layer=False "
                 "(the plain encoder path carries the ring attention)")
+        if cfg.sparse_attention_config is not None and cfg.use_fused_layer:
+            raise ValueError(
+                "sparse_attention_config requires use_fused_layer=False "
+                "(the plain encoder path carries the block-sparse kernel)")
+        if cfg.sparse_attention_config is not None and sp is not None:
+            raise ValueError(
+                "sparse attention x sequence parallelism is not supported "
+                "(the block-sparse layout is over the full sequence)")
 
         layer_cfg = cfg._ds_layer_config(training=not deterministic)
         for i in range(cfg.num_hidden_layers):

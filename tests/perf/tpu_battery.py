@@ -84,6 +84,10 @@ STAGES = [
     ("fp16", ["bench.py"], 2400,
      {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1",
       "DS_BENCH_FP16": "1"}),
+    ("bert", ["bench.py", "--bert"], 2400,
+     {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1"}),
+    ("bert_sparse", ["bench.py", "--bert-sparse"], 2400,
+     {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1"}),
     ("attn", ["tests/perf/attention_bench.py", "--dense"], 2400, {}),
     ("attn_split", ["tests/perf/attention_bench.py", "--bwd", "split"],
      2400, {}),

@@ -110,6 +110,74 @@ def test_bert_pretraining_trains_under_engine():
     assert losses[-1] < losses[0]
 
 
+def test_bert_sparse_attention_mask_zeroes_padding_influence():
+    """The additive key-padding mask must survive the hand-off into the
+    block-sparse kernel: varying the CONTENT of padded positions cannot
+    change the encoder output at kept positions."""
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+    sc = FixedSparsityConfig(num_heads=4, block=16,
+                             attention="bidirectional")
+    cfg = BertConfig.tiny(use_fused_layer=False, hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0,
+                          sparse_attention_config=sc)
+    model = BertModel(cfg)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(2, 32))
+    mask = np.ones((2, 32), np.int32)
+    mask[:, 24:] = 0  # last 8 positions are padding
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids),
+                        jnp.asarray(mask))
+    seq1, _, _ = model.apply(params, jnp.asarray(ids), jnp.asarray(mask))
+    ids2 = ids.copy()
+    ids2[:, 24:] = rng.randint(0, cfg.vocab_size, size=(2, 8))
+    seq2, _, _ = model.apply(params, jnp.asarray(ids2), jnp.asarray(mask))
+    np.testing.assert_allclose(
+        np.asarray(seq1[:, :24], np.float32),
+        np.asarray(seq2[:, :24], np.float32), atol=1e-5,
+        err_msg="padded-token content leaked through the sparse kernel")
+
+
+def test_bert_sparse_attention_model_path():
+    """BertConfig.sparse_attention_config routes the plain encoder through
+    the block-sparse kernel (model-level form of the reference's
+    sparse-attention swap); the model still trains."""
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+    sc = FixedSparsityConfig(num_heads=4, block=16,
+                             attention="bidirectional")
+    cfg = BertConfig.tiny(use_fused_layer=False, hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0,
+                          sparse_attention_config=sc)
+    engine, _, _, _ = deepspeed.initialize(
+        model=BertForPreTraining(cfg),
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        })
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(8, 32))
+    mlm_labels = np.full((8, 32), -1)
+    mlm_labels[:, ::5] = rng.randint(0, cfg.vocab_size, size=(8, 7))
+    nsp = rng.randint(0, 2, size=(8,))
+    losses = []
+    for _ in range(6):
+        loss = engine(ids, np.ones_like(ids), None,
+                      jnp.asarray(mlm_labels), jnp.asarray(nsp))
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_bert_sparse_requires_plain_layer():
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+    import pytest
+    cfg = BertConfig.tiny(sparse_attention_config=FixedSparsityConfig(
+        num_heads=4, block=16, attention="bidirectional"))
+    model = BertModel(cfg)
+    with pytest.raises(ValueError, match="use_fused_layer"):
+        model.init(jax.random.PRNGKey(0), jnp.asarray(_ids()))
+
+
 def test_pt_backwards_compat_aliases():
     import importlib
     mod = importlib.import_module("deepspeed_tpu.pt.deepspeed_utils")
