@@ -101,6 +101,8 @@ STAGES = [
      {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1"}),
     ("xl", ["bench.py", "--xl"], 4200,
      {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1"}),
+    ("decode", ["tests/perf/decode_bench.py"], 1800,
+     {"DS_BENCH_REQUIRE_TPU": "1"}),
     ("capacity", ["tests/perf/capacity_probe.py"], 10800, {}),
 ]
 
