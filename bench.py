@@ -270,12 +270,22 @@ def _emit(result):
             result["extra"]["last_good_tpu"] = last
             measured_at = (last.get("extra") or {}).get("git_hash")
             here = result["extra"]["git_hash"]
-            stale = bool(measured_at and here and measured_at != here)
-            result["extra"]["vs_baseline_source"] = (
-                "last_good_tpu (STALE: measured at {}, current {})".format(
-                    measured_at, here) if stale else "last_good_tpu")
+            if not (measured_at and here):
+                # Missing provenance must never read as "measured on the
+                # current code": stale is UNKNOWN (null), not False.
+                stale = None
+                result["extra"]["vs_baseline_source"] = (
+                    "last_good_tpu (UNKNOWN provenance: artifact has no "
+                    "git_hash)" if not measured_at
+                    else "last_good_tpu (UNKNOWN provenance: current git "
+                         "state unreadable)")
+            else:
+                stale = measured_at != here
+                result["extra"]["vs_baseline_source"] = (
+                    "last_good_tpu (STALE: measured at {}, current {})"
+                    .format(measured_at, here) if stale else "last_good_tpu")
             result["extra"]["last_good_stale_hash"] = stale
-            if not stale and measured_at and "-dirty" in measured_at:
+            if stale is False and measured_at and "-dirty" in measured_at:
                 # Equal dirty hashes cannot prove equal code — say so.
                 result["extra"]["last_good_hash_dirty"] = True
             result["vs_baseline"] = last.get("vs_baseline",

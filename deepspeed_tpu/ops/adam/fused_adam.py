@@ -16,6 +16,13 @@ import jax
 import jax.numpy as jnp
 
 
+def _static_zero(x):
+    """True only for a compile-time zero: a TRACED weight_decay (the
+    pipeline engine threads it as a jit argument) must always apply the
+    decay term — `tracer != 0` cannot be branched on at trace time."""
+    return isinstance(x, (int, float)) and x == 0.0
+
+
 def init_adam_state(params):
     """Zero first/second moments + step counter for a param pytree."""
     zeros_like = lambda p: jnp.zeros(p.shape, dtype=jnp.float32)
@@ -54,13 +61,13 @@ def adam_update(params,
     def _update(p, g, m, v):
         g = g.astype(jnp.float32)
         p32 = p.astype(jnp.float32)
-        if not adam_w_mode and weight_decay != 0.0:
+        if not adam_w_mode and not _static_zero(weight_decay):
             g = g + weight_decay * p32
         m_new = beta1 * m + (1.0 - beta1) * g
         v_new = beta2 * v + (1.0 - beta2) * jnp.square(g)
         denom = jnp.sqrt(v_new / bc2) + eps
         update = (m_new / bc1) / denom
-        if adam_w_mode and weight_decay != 0.0:
+        if adam_w_mode and not _static_zero(weight_decay):
             update = update + weight_decay * p32
         p_new = p32 - lr * update
         return p_new.astype(p.dtype), m_new, v_new
@@ -128,7 +135,8 @@ class FusedAdam(object):
     def init_state(self, params):
         return init_adam_state(params)
 
-    def update(self, params, grads, state, lr=None, betas=None):
+    def update(self, params, grads, state, lr=None, betas=None, eps=None,
+               weight_decay=None):
         group = self.param_groups[0]
         lr = group["lr"] if lr is None else lr
         beta1, beta2 = group["betas"] if betas is None else betas
@@ -138,8 +146,9 @@ class FusedAdam(object):
                            lr=lr,
                            beta1=beta1,
                            beta2=beta2,
-                           eps=group["eps"],
-                           weight_decay=group["weight_decay"],
+                           eps=group["eps"] if eps is None else eps,
+                           weight_decay=group["weight_decay"]
+                           if weight_decay is None else weight_decay,
                            adam_w_mode=self.adam_w_mode,
                            bias_correction=self.bias_correction)
 

@@ -13,6 +13,8 @@ lamb_coeff bounds (fused_lamb_cuda.cpp:5-40).
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.ops.adam.fused_adam import _static_zero
+
 
 def init_lamb_state(params):
     zeros_like = lambda p: jnp.zeros(p.shape, dtype=jnp.float32)
@@ -50,7 +52,7 @@ def lamb_update(params,
         m_new = beta1 * m + (1.0 - beta1) * g
         v_new = beta2 * v + (1.0 - beta2) * jnp.square(g)
         update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
-        if weight_decay != 0.0:
+        if not _static_zero(weight_decay):
             update = update + weight_decay * p32
         # Phase 1: per-tensor norms (the reference's cub block reductions).
         w_norm = jnp.linalg.norm(p32.reshape(-1))
@@ -119,7 +121,8 @@ class FusedLamb(object):
     def init_state(self, params):
         return init_lamb_state(params)
 
-    def update(self, params, grads, state, lr=None, betas=None):
+    def update(self, params, grads, state, lr=None, betas=None, eps=None,
+               weight_decay=None):
         group = self.param_groups[0]
         lr = group["lr"] if lr is None else lr
         beta1, beta2 = group["betas"] if betas is None else betas
@@ -129,8 +132,9 @@ class FusedLamb(object):
                            lr=lr,
                            beta1=beta1,
                            beta2=beta2,
-                           eps=group["eps"],
-                           weight_decay=group["weight_decay"],
+                           eps=group["eps"] if eps is None else eps,
+                           weight_decay=group["weight_decay"]
+                           if weight_decay is None else weight_decay,
                            bias_correction=self.bias_correction,
                            max_coeff=self.max_coeff,
                            min_coeff=self.min_coeff)

@@ -624,15 +624,56 @@ def _bwd_fused_kernel(*refs, scale, causal, block_q, block_k, has_mask,
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-# Per-(b, h) VMEM for the fused backward's RESIDENT tensors: k/v inputs
-# + dk/dv outputs in the model dtype, plus two full-length fp32
-# accumulators. The budget is set well under the ~16 MB/core VMEM
-# because the loop's [block_q, block_k] fp32 score/prob intermediates
-# (~8 MB at 1024x1024 blocks) and pipeline double-buffering also live
-# there; beyond it the split two-kernel path streams blocks instead.
-# Overridable for experiments.
+# Scoped-VMEM budget for the fused backward's TOTAL estimated footprint
+# (resident k/v/dk/dv + fp32 accumulators + live [block_q, block_k]
+# loop intermediates + double-buffered q-side blocks). The hardware
+# limit is ~16 MB/core; 12 MB leaves headroom for Mosaic's own stack
+# slop. Measured live (v5e, r5): 1024x1024 tiles stack-OOMed at 20.82 MB
+# vs the 16 MB limit — the old resident-only estimate missed the ~16 MB
+# of score-sized intermediates entirely. Overridable for experiments.
 _FUSED_BWD_VMEM_BUDGET = int(os.environ.get(
-    "DS_TPU_FUSED_BWD_MAX_BYTES", 6 * 1024 * 1024))
+    "DS_TPU_FUSED_BWD_MAX_BYTES", 12 * 1024 * 1024))
+
+# Resident-only gate used by _bwd_mode for callers that cannot shrink
+# tiles (the block-sparse fused backward keeps full-length k/v/dk/dv
+# resident and layouts its own loop blocks): kept at the pre-r5 6 MB so
+# raising the total-footprint budget above does not silently admit
+# sparse shapes whose resident set alone crowds out the loop
+# intermediates.
+_RESIDENT_BWD_VMEM_BUDGET = 6 * 1024 * 1024
+
+
+def _fused_bwd_vmem_bytes(t_kv, d, dtype, block_q, block_k, causal):
+    """Estimated scoped-VMEM footprint of one fused-backward program
+    instance. Counts what the kernel actually keeps live (see
+    _bwd_fused_kernel): resident k/v + dk/dv outputs (model dtype) and
+    two full-length fp32 accumulators; per-loop [block_q, block_k]
+    intermediates — s and dpd in fp32, p and ds in the model dtype —
+    plus the fp32 tril block when causal uses equal tiles; and the
+    double-buffered streamed q/do/dq blocks."""
+    itemsize = jnp.dtype(dtype).itemsize
+    resident = t_kv * d * (4 * itemsize + 2 * 4)
+    per_elem = 2 * 4 + 2 * itemsize + \
+        (4 if causal and block_q == block_k else 0)
+    streamed = 2 * 3 * block_q * d * itemsize
+    return resident + block_q * block_k * per_elem + streamed
+
+
+def _fit_fused_bwd_tiles(t_kv, d, dtype, block_q, block_k, causal):
+    """Largest (block_q, block_k) <= the requested tiles whose estimated
+    footprint fits the budget, halving the larger side first (both sides
+    stay >= 128 and keep dividing the sequence since the requested tiles
+    do and only halving happens). None if nothing fits."""
+    bq, bk = block_q, block_k
+    while _fused_bwd_vmem_bytes(t_kv, d, dtype, bq, bk, causal) > \
+            _FUSED_BWD_VMEM_BUDGET:
+        if max(bq, bk) <= 128:
+            return None
+        if bq >= bk and bq > 128:
+            bq //= 2
+        else:
+            bk //= 2
+    return bq, bk
 
 
 @functools.lru_cache(maxsize=None)
@@ -671,7 +712,7 @@ def _bwd_mode(t_kv, d, dtype):
         return mode
     itemsize = jnp.dtype(dtype).itemsize
     resident = t_kv * d * (4 * itemsize + 2 * 4)
-    if resident > _FUSED_BWD_VMEM_BUDGET:
+    if resident > _RESIDENT_BWD_VMEM_BUDGET:
         return "split"
     return "fused" if _fused_bwd_supported() else "split"
 
@@ -741,8 +782,20 @@ def _flash_bwd_pallas(q, k, v, mask, delta, lse, g, scale, causal, block_q,
     # saved lse); dk needs no correction, dq is rescaled on its output.
     q = (q.astype(jnp.float32) * scale).astype(q.dtype)
     if _bwd_mode(t_kv, d, q.dtype) == "fused":
-        return _flash_bwd_fused_pallas(q, k, v, mask, delta, lse, do, scale,
-                                       causal, block_q, block_k)
+        # The forward's (autotuned) tiles can be too big for the fused
+        # backward's larger live set — shrink just the backward's tiles
+        # to the VMEM fit rather than abandoning the one-pass kernel
+        # (measured live: 1024x1024 stack-OOMed the 16 MB scoped limit).
+        fit = _fit_fused_bwd_tiles(t_kv, d, q.dtype, block_q, block_k,
+                                   causal)
+        if fit is not None:
+            return _flash_bwd_fused_pallas(q, k, v, mask, delta, lse, do,
+                                           scale, causal, fit[0], fit[1])
+        if os.environ.get("DS_TPU_FLASH_BWD") == "fused":
+            # Explicitly forced: honor the request (and its tiles) even
+            # if the estimate says it cannot fit.
+            return _flash_bwd_fused_pallas(q, k, v, mask, delta, lse, do,
+                                           scale, causal, block_q, block_k)
     use_tril = causal and block_q == block_k
     tril = _tril_block(block_q, block_k) if use_tril else None
 

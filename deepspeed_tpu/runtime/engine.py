@@ -1847,6 +1847,17 @@ class DeepSpeedEngine(object):
         module = self.module
         cast = self._cast_to_compute
         clip = self.gradient_clipping()
+        if clip > 0.0 and frozen and not getattr(
+                self, "_onebit_clip_warned", False):
+            # The compression phase operates on UNCLIPPED local grads
+            # (reference onebit_adam.py compression phase does too, but
+            # its fp16 wrapper still unscales+clips first) — tell users
+            # their clip value stops applying past the freeze boundary.
+            self._onebit_clip_warned = True
+            logger.warning(
+                "1-bit Adam compressed phase ignores gradient_clipping=%s: "
+                "clipping applies only during warmup; the quantization "
+                "scale bounds the exchanged update instead.", clip)
         opt = self.optimizer
         group = opt.param_groups[0]
         eps = group["eps"]

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.ops.adam.fused_adam import _static_zero
 from deepspeed_tpu.runtime.custom_collectives import (
     compressed_allreduce, corrected_size, quantize_error_feedback)
 from deepspeed_tpu.utils.logging import logger
@@ -103,6 +104,17 @@ def onebit_adam_update(params, grads, state, lr, beta1=0.9, beta2=0.999,
         # degenerate pre-averaged path sees identical state on every
         # worker, so row 0 is THE state — compute on it, broadcast back.
         we_rows = werr.ndim == 2
+        if we_rows and werr.shape[0] > 1 and axis_name is not None:
+            # Under shard_map every rank would read ROW 0 of a REPLICATED
+            # [W, n] buffer — silently sharing rank 0's error feedback.
+            # Callers on the collective path must pre-slice their own row
+            # (as the engine hot path does, _build_onebit_spmd_fused); a
+            # [1, n] shard (buffer already sharded over the axis) is that
+            # rank's own row and passes.
+            raise ValueError(
+                "onebit_adam_update(axis_name=...) saw a replicated "
+                "multi-row error buffer; slice your worker's row before "
+                "calling")
         we = werr[0] if we_rows else werr
 
         def warmup(_):
@@ -133,7 +145,7 @@ def onebit_adam_update(params, grads, state, lr, beta1=0.9, beta2=0.999,
                 step <= freeze_step, warmup, frozen_branch, operand=None)
 
         update = m_new / (jnp.sqrt(v_new) + eps)
-        if weight_decay > 0.0:
+        if not _static_zero(weight_decay):
             update = update + weight_decay * p32
         p_new = p32 - lr * update
         return p_new.astype(p.dtype), m_new, v_new, werr_new, serr_new
@@ -216,14 +228,17 @@ class OnebitAdam(object):
         return init_onebit_adam_state(params, self.world_size,
                                       per_worker_rows=rows)
 
-    def update(self, params, grads, state, lr=None, betas=None):
+    def update(self, params, grads, state, lr=None, betas=None, eps=None,
+               weight_decay=None):
         group = self.param_groups[0]
         lr = group["lr"] if lr is None else lr
         beta1, beta2 = group["betas"] if betas is None else betas
         new_params, new_state = onebit_adam_update(
             params, grads, state,
             lr=lr, beta1=beta1, beta2=beta2,
-            eps=group["eps"], weight_decay=group["weight_decay"],
+            eps=group["eps"] if eps is None else eps,
+            weight_decay=group["weight_decay"]
+            if weight_decay is None else weight_decay,
             freeze_step=self.freeze_step,
             axis_name=self.axis_name,
             world_size=self.world_size,

@@ -676,12 +676,18 @@ class PipelineEngine(DeepSpeedEngine):
                 # profile); one jitted wrapper compiles per layer-pytree
                 # structure and then every step is a cached dispatch.
                 opt = self.optimizer
+                # eps/weight_decay ride along as traced args so later
+                # param_group mutations (not just lr/betas) take effect
+                # without a re-trace.
                 self._opt_update_jit = jax.jit(
-                    lambda p, g, s, lr_, b1, b2: opt.update(
-                        p, g, s, lr=lr_, betas=(b1, b2)))
+                    lambda p, g, s, lr_, b1, b2, eps_, wd_: opt.update(
+                        p, g, s, lr=lr_, betas=(b1, b2), eps=eps_,
+                        weight_decay=wd_))
             new_p, new_s = self._opt_update_jit(
                 params, self.grad_acc[i], self.pipe_opt_state[i],
-                lr, jnp.float32(beta1), jnp.float32(beta2))
+                lr, jnp.float32(beta1), jnp.float32(beta2),
+                jnp.float32(group["eps"]),
+                jnp.float32(group["weight_decay"]))
             self.layer_params[i] = new_p
             self.pipe_opt_state[i] = new_s
             # refresh the per-stage replicas of tied weights

@@ -114,6 +114,41 @@ def test_emit_fallback_embeds_last_good(bench, monkeypatch, tmp_path,
     assert out["vs_baseline"] == 1.0005
 
 
+def test_emit_fallback_missing_hash_reports_unknown_not_fresh(
+        bench, monkeypatch, tmp_path, capsys):
+    # VERDICT r4 weak#2: a replayed artifact with NO git_hash must report
+    # provenance UNKNOWN (stale=None), never False ("fresh").
+    last = {"metric": "m", "value": 44955.0, "unit": "tok/s",
+            "vs_baseline": 1.0005, "extra": {"platform": "tpu"}}
+    p = tmp_path / "last_good_tpu.json"
+    p.write_text(json.dumps({"m": last}))
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(p))
+    monkeypatch.setenv("DS_BENCH_FALLBACK", "accelerator-init-failed")
+
+    bench._emit({"metric": "m", "value": 100.0, "unit": "tok/s",
+                 "vs_baseline": 0.02, "extra": {"platform": "cpu"}})
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["extra"]["last_good_stale_hash"] is None
+    assert "UNKNOWN provenance" in out["extra"]["vs_baseline_source"]
+
+
+def test_emit_fallback_stale_hash_flagged(bench, monkeypatch, tmp_path,
+                                          capsys):
+    last = {"metric": "m", "value": 44955.0, "unit": "tok/s",
+            "vs_baseline": 1.0005,
+            "extra": {"platform": "tpu", "git_hash": "unknown-pre-r4"}}
+    p = tmp_path / "last_good_tpu.json"
+    p.write_text(json.dumps({"m": last}))
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(p))
+    monkeypatch.setenv("DS_BENCH_FALLBACK", "accelerator-init-failed")
+
+    bench._emit({"metric": "m", "value": 100.0, "unit": "tok/s",
+                 "vs_baseline": 0.02, "extra": {"platform": "cpu"}})
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["extra"]["last_good_stale_hash"] is True
+    assert "STALE" in out["extra"]["vs_baseline_source"]
+
+
 def test_emit_fallback_smoke_metric_maps_to_tpu_metric(bench, monkeypatch,
                                                        tmp_path, capsys):
     # The CPU smoke runs a tiny model whose metric name differs from the
