@@ -636,11 +636,14 @@ _FUSED_BWD_VMEM_BUDGET = int(os.environ.get(
 
 # Resident-only gate used by _bwd_mode for callers that cannot shrink
 # tiles (the block-sparse fused backward keeps full-length k/v/dk/dv
-# resident and layouts its own loop blocks): kept at the pre-r5 6 MB so
-# raising the total-footprint budget above does not silently admit
+# resident and layouts its own loop blocks): defaults to the pre-r5 6 MB
+# so the larger total-footprint default above does not silently admit
 # sparse shapes whose resident set alone crowds out the loop
-# intermediates.
-_RESIDENT_BWD_VMEM_BUDGET = 6 * 1024 * 1024
+# intermediates — but an EXPLICIT DS_TPU_FUSED_BWD_MAX_BYTES keeps its
+# historical power to admit larger resident sets.
+_RESIDENT_BWD_VMEM_BUDGET = (
+    int(os.environ["DS_TPU_FUSED_BWD_MAX_BYTES"])
+    if "DS_TPU_FUSED_BWD_MAX_BYTES" in os.environ else 6 * 1024 * 1024)
 
 
 def _fused_bwd_vmem_bytes(t_kv, d, dtype, block_q, block_k, causal):
@@ -782,6 +785,12 @@ def _flash_bwd_pallas(q, k, v, mask, delta, lse, g, scale, causal, block_q,
     # saved lse); dk needs no correction, dq is rescaled on its output.
     q = (q.astype(jnp.float32) * scale).astype(q.dtype)
     if _bwd_mode(t_kv, d, q.dtype) == "fused":
+        if os.environ.get("DS_TPU_FLASH_BWD") == "fused":
+            # Explicitly forced: honor the request AND its exact tiles —
+            # an A/B experiment must measure the configured tiling, not
+            # a silently substituted one.
+            return _flash_bwd_fused_pallas(q, k, v, mask, delta, lse, do,
+                                           scale, causal, block_q, block_k)
         # The forward's (autotuned) tiles can be too big for the fused
         # backward's larger live set — shrink just the backward's tiles
         # to the VMEM fit rather than abandoning the one-pass kernel
@@ -791,11 +800,6 @@ def _flash_bwd_pallas(q, k, v, mask, delta, lse, g, scale, causal, block_q,
         if fit is not None:
             return _flash_bwd_fused_pallas(q, k, v, mask, delta, lse, do,
                                            scale, causal, fit[0], fit[1])
-        if os.environ.get("DS_TPU_FLASH_BWD") == "fused":
-            # Explicitly forced: honor the request (and its tiles) even
-            # if the estimate says it cannot fit.
-            return _flash_bwd_fused_pallas(q, k, v, mask, delta, lse, do,
-                                           scale, causal, block_q, block_k)
     use_tril = causal and block_q == block_k
     tril = _tril_block(block_q, block_k) if use_tril else None
 

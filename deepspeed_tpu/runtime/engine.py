@@ -428,6 +428,20 @@ class DeepSpeedEngine(object):
     def gradient_clipping(self):
         return self._config.gradient_clipping
 
+    def _warn_onebit_clip_once(self, clip):
+        """One-time notice that 1-bit Adam's compression phase operates on
+        UNCLIPPED local grads (the reference compression phase does too,
+        but its fp16 wrapper still unscales+clips first) — a configured
+        clip value stops applying past the freeze boundary. Shared by the
+        base engine's shard_map hot path and the pipeline engine's
+        per-stage compressed update."""
+        if clip > 0.0 and not getattr(self, "_onebit_clip_warned", False):
+            self._onebit_clip_warned = True
+            logger.warning(
+                "1-bit Adam compressed phase ignores gradient_clipping=%s: "
+                "clipping applies only during warmup; the quantization "
+                "scale bounds the exchanged update instead.", clip)
+
     def optimizer_name(self):
         return self.client_optimizer.__class__.__name__ \
             if self.client_optimizer else self._config.optimizer_name
@@ -1847,17 +1861,8 @@ class DeepSpeedEngine(object):
         module = self.module
         cast = self._cast_to_compute
         clip = self.gradient_clipping()
-        if clip > 0.0 and frozen and not getattr(
-                self, "_onebit_clip_warned", False):
-            # The compression phase operates on UNCLIPPED local grads
-            # (reference onebit_adam.py compression phase does too, but
-            # its fp16 wrapper still unscales+clips first) — tell users
-            # their clip value stops applying past the freeze boundary.
-            self._onebit_clip_warned = True
-            logger.warning(
-                "1-bit Adam compressed phase ignores gradient_clipping=%s: "
-                "clipping applies only during warmup; the quantization "
-                "scale bounds the exchanged update instead.", clip)
+        if frozen:
+            self._warn_onebit_clip_once(clip)
         opt = self.optimizer
         group = opt.param_groups[0]
         eps = group["eps"]

@@ -97,7 +97,17 @@ class PipelineEngine(DeepSpeedEngine):
         self.pipe_opt_state = None
         self._stage_fwd = {}  # stage_id -> jitted stage function
         self._stage_fwd_bwd = {}  # stage_id -> (fwd+res jit, bwd jit)
-        self._opt_update_jit = None  # cached jitted per-layer update
+        self._stage_bwd_local = {}  # stage_id -> local-grad bwd (1-bit frozen)
+        self._stage_opt_jit = {}  # (stage, idxs, compressed) -> jitted update
+        self._grad_acc_jit = {}  # stage_id -> jitted grad accumulate
+        self._seed_cache = {}  # (shape, dtype, scale) -> backward seed
+        self._handlers = {}  # instruction type -> bound handler
+        # Shardings are constructed once per stage, not per instruction —
+        # NamedSharding construction showed up on the dispatch profile.
+        self._stage_rep_sh = [NamedSharding(m, P())
+                              for m in self.stage_meshes]
+        self._stage_batch_sh = [NamedSharding(m, P(mesh_lib.DATA_AXIS))
+                                for m in self.stage_meshes]
         self._materialized = False
 
         self.grad_acc = [None] * len(self.layers)  # per-layer grad pytrees
@@ -153,15 +163,15 @@ class PipelineEngine(DeepSpeedEngine):
                 mesh, tree, 0,
                 tp_rules=getattr(self.pipe_module, "tp_rules", None))
             return jax.device_put(tree, sh)
-        return jax.device_put(tree, NamedSharding(mesh, P()))
+        return jax.device_put(tree, self._stage_rep_sh[stage_id])
 
     def _place_batch(self, tree, stage_id):
         """Shard batch-leading arrays over the stage's 'data' axis; leaves
         whose leading dim does not divide stay replicated."""
         mesh = self.stage_meshes[stage_id]
         dp = mesh.shape.get(mesh_lib.DATA_AXIS, 1)
-        batch_sh = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
-        rep = NamedSharding(mesh, P())
+        batch_sh = self._stage_batch_sh[stage_id]
+        rep = self._stage_rep_sh[stage_id]
 
         def _put(x):
             if dp > 1 and hasattr(x, "shape") and len(x.shape) > 0 \
@@ -216,8 +226,20 @@ class PipelineEngine(DeepSpeedEngine):
                                   jax.random.PRNGKey(0))
         # Optimizer state per parameterized layer, co-located with its stage.
         if self.optimizer is not None:
+            if self._onebit_pp_capable():
+                # 1-bit Adam over PP x DP: error feedback is per-rank state
+                # (reference keeps it in each rank's optimizer,
+                # onebit_adam.py:295-309) — one row per worker of the
+                # stage's data axis, sliced inside the compressed
+                # shard_map update.
+                from deepspeed_tpu.runtime.fp16.onebit_adam import (
+                    init_onebit_adam_state)
+                init = lambda p: init_onebit_adam_state(
+                    p, self._pipe_dp, per_worker_rows=True)
+            else:
+                init = self.optimizer.init_state
             self.pipe_opt_state = [
-                self._place(self.optimizer.init_state(p),
+                self._place(init(p),
                             self._stage_of_layer(i)) if p is not None else None
                 for i, p in enumerate(self.layer_params)
             ]
@@ -241,6 +263,66 @@ class PipelineEngine(DeepSpeedEngine):
         # base engine's 1-bit shard_map hot path (and its per-worker
         # error-row state layout) never applies here.
         return False
+
+    def _onebit_pp_capable(self):
+        """Whether THIS pipeline can run 1-bit Adam's compressed momentum
+        exchange over each stage's data-axis submesh (BASELINE config #5:
+        PP x DP + 1-bit; reference custom_collectives.py:10-155 composes
+        with any engine because it is optimizer-level). Requires real
+        data-parallel replication within stages and no tensor axis (the
+        local-grad shard_map treats the whole stage submesh as 'data')."""
+        from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdam
+        return (isinstance(self.optimizer, OnebitAdam)
+                and self._pipe_dp > 1 and self.mp_world_size <= 1)
+
+    def _onebit_pp_compressed_active(self):
+        """True once the optimizer crossed freeze_step: backward switches
+        to per-worker local grads and OptimizerStep to the compressed
+        exchange (one re-trace at the boundary, like the base engine)."""
+        return self._onebit_pp_capable() and self.optimizer.adam_freeze_key
+
+    def _get_stage_bwd_local(self, stage_id):
+        """Backward variant for the 1-bit compression phase: param grads
+        come back UN-averaged, one row per data-parallel worker, stacked
+        on a leading axis sharded over the stage's 'data' axis. The dense
+        bwd's implicit GSPMD all_reduce of param cotangents (replicated
+        params, sharded batch) is thereby removed from the wire — the
+        frozen phase's only exchange is the sign-packed momentum in
+        OptimizerStep (reference disables dense allreduce past
+        freeze_step, onebit_adam.py:369-372)."""
+        if stage_id in self._stage_bwd_local:
+            return self._stage_bwd_local[stage_id]
+        from jax import shard_map
+
+        mesh = self.stage_meshes[stage_id]
+        axis = mesh_lib.DATA_AXIS
+        raw_fn = self._build_stage_fn(stage_id)
+        tm = jax.tree_util.tree_map
+
+        def worker(params_list, x, labels, rng, seed):
+            def f(ps, xx):
+                return raw_fn(ps, xx, labels, rng)
+
+            _, vjp = jax.vjp(f, params_list, x)
+            param_grads, in_grad = vjp(seed)
+            # [1, ...] local row -> stacks to [dp, ...] under out_spec.
+            return tm(lambda g: g[None], param_grads), in_grad
+
+        def bwd(params_list, x, labels, rng, seed):
+            # Prefix specs: P() replicates every leaf, P(axis) shards every
+            # leaf's dim 0 (the batch dim of x/labels/mid-stage seeds, the
+            # added worker row of param grads); a scalar loss seed (last
+            # stage) is replicated.
+            seed_spec = P(axis) if getattr(seed, "ndim", 0) > 0 else P()
+            return shard_map(
+                worker, mesh=mesh,
+                in_specs=(P(), P(axis), P(axis), P(), seed_spec),
+                out_specs=(P(axis), P(axis)),
+                check_vma=False)(params_list, x, labels, rng, seed)
+
+        jitted = jax.jit(bwd)
+        self._stage_bwd_local[stage_id] = jitted
+        return jitted
 
     def _get_stage_fn(self, stage_id):
         """One jitted function running all of a stage's layers; last stage
@@ -375,6 +457,12 @@ class PipelineEngine(DeepSpeedEngine):
                                 train=True)
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
+        if hasattr(self.optimizer, "notify_step"):
+            # Freeze-boundary bookkeeping (reference onebit_adam.py:369-372)
+            # — past freeze_step the backward switches to local grads and
+            # OptimizerStep to the compressed momentum exchange.
+            self.optimizer.notify_step(self.global_steps -
+                                       self.skipped_steps)
         self._last_loss = self.agg_loss
         self._tensorboard_step_events()
         if self.lr_scheduler is not None:
@@ -471,8 +559,11 @@ class PipelineEngine(DeepSpeedEngine):
         return self.agg_loss
 
     def _dispatch(self, cmd, stage_id, state):
-        name = type(cmd).__name__
-        handler = getattr(self, "_exec_" + _camel_to_snake(name))
+        handler = self._handlers.get(type(cmd))
+        if handler is None:
+            handler = getattr(
+                self, "_exec_" + _camel_to_snake(type(cmd).__name__))
+            self._handlers[type(cmd)] = handler
         handler(cmd, stage_id, state)
 
     # ------------------------------------------------------------ instruction
@@ -537,31 +628,47 @@ class PipelineEngine(DeepSpeedEngine):
         buf = state["buffers"][stage_id]
         residuals = buf["vjp"].pop(cmd.buffer_id)
         if stage_id == self.num_stages - 1:
-            seed = jnp.ones_like(buf["outputs"][cmd.buffer_id])
-            # scale for mean over micro-batches (reference divides loss by gas)
-            seed = seed / self.micro_batches
-            if self.loss_scaler is not None:
-                # fp16 loss scaling rides the backward seed; grads are
-                # unscaled (or the step skipped) at OptimizerStep, matching
-                # the reference fp16 step path the pipeline engine inherits.
-                seed = seed * jnp.asarray(self.loss_scaler.loss_scale,
-                                          seed.dtype)
+            out = buf["outputs"][cmd.buffer_id]
+            # Constant seed (ones / gas, x loss scale): built once per
+            # (shape, scale) and reused — two eager dispatches per
+            # micro-batch showed up on the dispatch profile.
+            scale = (self.loss_scaler.loss_scale
+                     if self.loss_scaler is not None else 1.0)
+            key = (getattr(out, "shape", ()), str(getattr(out, "dtype", "")),
+                   float(scale))
+            seed = self._seed_cache.get(key)
+            if seed is None:
+                seed = jnp.ones_like(out) * (scale / self.micro_batches)
+                self._seed_cache[key] = seed
         else:
             seed = buf["out_grad"].pop(cmd.buffer_id)
-        _, bwd = self._get_stage_fwd_bwd(stage_id)
+        if self._onebit_pp_compressed_active():
+            # 1-bit compression phase: per-worker local grads, no dense
+            # allreduce on the wire (see _get_stage_bwd_local).
+            bwd = self._get_stage_bwd_local(stage_id)
+        else:
+            _, bwd = self._get_stage_fwd_bwd(stage_id)
         b_params, b_x, b_labels, b_rng = residuals
         param_grads, in_grad = bwd(b_params, b_x, b_labels, b_rng, seed)
         buf["in_grad"][cmd.buffer_id] = in_grad
         start, stop = self.pipe_module.stage_layer_range(stage_id)
-        for j, gi in enumerate(range(start, stop)):
-            g = param_grads[j]
-            if g is None:
-                continue
-            if self.grad_acc[gi] is None:
-                self.grad_acc[gi] = g
-            else:
-                self.grad_acc[gi] = jax.tree_util.tree_map(
-                    lambda a, b: a + b, self.grad_acc[gi], g)
+        live = [(j, gi) for j, gi in enumerate(range(start, stop))
+                if param_grads[j] is not None]
+        if all(self.grad_acc[gi] is None for _, gi in live):
+            for j, gi in live:
+                self.grad_acc[gi] = param_grads[j]
+        else:
+            # One jitted add over the whole stage's grads instead of an
+            # eager per-leaf tree_map per layer (dispatch-profile item).
+            acc_fn = self._grad_acc_jit.get(stage_id)
+            if acc_fn is None:
+                acc_fn = jax.jit(lambda a, b: jax.tree_util.tree_map(
+                    lambda x_, y_: x_ + y_, a, b), donate_argnums=0)
+                self._grad_acc_jit[stage_id] = acc_fn
+            acc = acc_fn(tuple(self.grad_acc[gi] for _, gi in live),
+                         tuple(param_grads[j] for j, _ in live))
+            for n, (_, gi) in enumerate(live):
+                self.grad_acc[gi] = acc[n]
         buf["outputs"].pop(cmd.buffer_id, None)
 
     def _exec_send_activation(self, cmd, stage_id, state):
@@ -608,6 +715,100 @@ class PipelineEngine(DeepSpeedEngine):
         # pipe/engine.py:221-242).
         pass
 
+    def _get_stage_opt_jit(self, stage_id, idxs, compressed):
+        """One jitted optimizer update covering ALL of a stage's layers —
+        a single cached-executable dispatch per stage per step instead of
+        one per layer (dispatch-profile item; the reference's analogue is
+        one multi-tensor-apply launch over chunked params,
+        csrc/adam/multi_tensor_adam.cu).
+
+        With ``compressed`` (1-bit Adam past freeze_step), the update runs
+        under shard_map over the stage's data axis: each worker feeds its
+        LOCAL gradient row into local momentum and the only exchange is
+        the sign-packed compressed_allreduce — uint8 n/8 + scales on the
+        wire (reference custom_collectives.py:10-155)."""
+        key = (stage_id, idxs, compressed)
+        fn = self._stage_opt_jit.get(key)
+        if fn is not None:
+            return fn
+        opt = self.optimizer
+        tm = jax.tree_util.tree_map
+
+        if not compressed:
+            # Client (duck-typed) optimizers satisfy the historical
+            # contract update(p, g, s, lr=, betas=); only pass the newer
+            # eps/weight_decay kwargs to optimizers that accept them.
+            import inspect
+            try:
+                accepts = set(inspect.signature(opt.update).parameters)
+            except (TypeError, ValueError):
+                accepts = set()
+            extra = {"eps", "weight_decay"} <= accepts
+
+            def multi(ps, gs, ss, lr, b1, b2, eps, wd):
+                kw = dict(eps=eps, weight_decay=wd) if extra else {}
+                outs = [opt.update(p, g, s, lr=lr, betas=(b1, b2), **kw)
+                        for p, g, s in zip(ps, gs, ss)]
+                return (tuple(o[0] for o in outs),
+                        tuple(o[1] for o in outs))
+
+            fn = jax.jit(multi, donate_argnums=(0, 2))
+        else:
+            from jax import shard_map
+
+            from deepspeed_tpu.runtime.fp16.onebit_adam import (
+                onebit_adam_update)
+
+            mesh = self.stage_meshes[stage_id]
+            axis = mesh_lib.DATA_AXIS
+            dp = mesh.shape.get(axis, 1)
+            freeze_step = opt.freeze_step
+
+            def worker(ps, gs, ss, lr, b1, b2, eps, wd):
+                new_ps, new_ss = [], []
+                for p, g, s in zip(ps, gs, ss):
+                    st = dict(s)
+                    st["worker_error"] = tm(lambda e: e[0],
+                                            s["worker_error"])
+                    st["server_error"] = tm(lambda e: e[0],
+                                            s["server_error"])
+                    np_, ns = onebit_adam_update(
+                        p, tm(lambda a: a[0], g), st, lr=lr, beta1=b1,
+                        beta2=b2, eps=eps, weight_decay=wd,
+                        freeze_step=freeze_step, axis_name=axis,
+                        world_size=dp, frozen=True)
+                    ns["worker_error"] = tm(lambda e: e[None],
+                                            ns["worker_error"])
+                    ns["server_error"] = tm(lambda e: e[None],
+                                            ns["server_error"])
+                    new_ps.append(np_)
+                    new_ss.append(ns)
+                return tuple(new_ps), tuple(new_ss)
+
+            def state_spec(s):
+                return {
+                    "step": P(),
+                    "exp_avg": tm(lambda _: P(), s["exp_avg"]),
+                    "exp_avg_sq": tm(lambda _: P(), s["exp_avg_sq"]),
+                    "worker_error": tm(lambda _: P(axis),
+                                       s["worker_error"]),
+                    "server_error": tm(lambda _: P(axis),
+                                       s["server_error"]),
+                }
+
+            def multi(ps, gs, ss, lr, b1, b2, eps, wd):
+                sspec = tuple(state_spec(s) for s in ss)
+                return shard_map(
+                    worker, mesh=mesh,
+                    in_specs=(P(), P(axis), sspec, P(), P(), P(), P(),
+                              P()),
+                    out_specs=(P(), sspec),
+                    check_vma=False)(ps, gs, ss, lr, b1, b2, eps, wd)
+
+            fn = jax.jit(multi, donate_argnums=(0, 2))
+        self._stage_opt_jit[key] = fn
+        return fn
+
     def _exec_optimizer_step(self, cmd, stage_id, state):
         if stage_id != 0:
             return  # single-controller: run the global update once
@@ -615,6 +816,7 @@ class PipelineEngine(DeepSpeedEngine):
         lr = jnp.float32(group["lr"])
         beta1, beta2 = group.get("betas", (0.9, 0.999))
         clip = self.gradient_clipping()
+        compressed = self._onebit_pp_compressed_active()
 
         # fp16 dynamic-loss-scale bookkeeping (reference pipe engine inherits
         # the full fp16 step path): grads carry the scale from the backward
@@ -648,6 +850,9 @@ class PipelineEngine(DeepSpeedEngine):
         # Layers live on different stage submeshes, so per-layer squared norms
         # are reduced on each stage's devices and combined on host; the scale
         # factor is then broadcast back into each stage's program.
+        if clip > 0.0 and compressed:
+            self._warn_onebit_clip_once(clip)
+            clip = 0.0
         if clip > 0.0:
             from deepspeed_tpu.runtime.utils import jit_global_norm_sq
             sqs = [jit_global_norm_sq(g)
@@ -661,40 +866,42 @@ class PipelineEngine(DeepSpeedEngine):
                             x.dtype), g) if g is not None else None
                     for g in self.grad_acc]
 
+        # One batched update per STAGE (not per layer): eps/weight_decay
+        # ride along as traced args so later param_group mutations (not
+        # just lr/betas) take effect without a re-trace.
+        scalars = (lr, jnp.float32(beta1), jnp.float32(beta2),
+                   jnp.float32(group.get("eps", 1e-8)),
+                   jnp.float32(group.get("weight_decay", 0.0)))
         seen_tied = set()
-        for i, params in enumerate(self.layer_params):
-            if params is None or self.grad_acc[i] is None:
-                continue
-            spec = self.pipe_module.layer_specs[i]
-            if isinstance(spec, TiedLayerSpec):
-                if spec.key in seen_tied:
+        for sid in range(self.num_stages):
+            start, stop = self.pipe_module.stage_layer_range(sid)
+            idxs = []
+            for i in range(start, stop):
+                if self.layer_params[i] is None or self.grad_acc[i] is None:
                     continue
-                seen_tied.add(spec.key)
-            if self._opt_update_jit is None:
-                # Eager optimizer.update dispatches the Adam math op-by-op
-                # per layer (measured 0.3-1.0 s/step on the dispatch
-                # profile); one jitted wrapper compiles per layer-pytree
-                # structure and then every step is a cached dispatch.
-                opt = self.optimizer
-                # eps/weight_decay ride along as traced args so later
-                # param_group mutations (not just lr/betas) take effect
-                # without a re-trace.
-                self._opt_update_jit = jax.jit(
-                    lambda p, g, s, lr_, b1, b2, eps_, wd_: opt.update(
-                        p, g, s, lr=lr_, betas=(b1, b2), eps=eps_,
-                        weight_decay=wd_))
-            new_p, new_s = self._opt_update_jit(
-                params, self.grad_acc[i], self.pipe_opt_state[i],
-                lr, jnp.float32(beta1), jnp.float32(beta2),
-                jnp.float32(group["eps"]),
-                jnp.float32(group["weight_decay"]))
-            self.layer_params[i] = new_p
-            self.pipe_opt_state[i] = new_s
-            # refresh the per-stage replicas of tied weights
-            if isinstance(spec, TiedLayerSpec):
-                for j in self.pipe_module.tied_specs[spec.key]:
-                    self.layer_params[j] = self._place(
-                        new_p, self._stage_of_layer(j))
+                spec = self.pipe_module.layer_specs[i]
+                if isinstance(spec, TiedLayerSpec):
+                    if spec.key in seen_tied:
+                        continue
+                    seen_tied.add(spec.key)
+                idxs.append(i)
+            if not idxs:
+                continue
+            fn = self._get_stage_opt_jit(sid, tuple(idxs), compressed)
+            new_ps, new_ss = fn(
+                tuple(self.layer_params[i] for i in idxs),
+                tuple(self.grad_acc[i] for i in idxs),
+                tuple(self.pipe_opt_state[i] for i in idxs), *scalars)
+            for n, i in enumerate(idxs):
+                self.layer_params[i] = new_ps[n]
+                self.pipe_opt_state[i] = new_ss[n]
+                spec = self.pipe_module.layer_specs[i]
+                # refresh the per-stage replicas of tied weights
+                if isinstance(spec, TiedLayerSpec):
+                    for j in self.pipe_module.tied_specs[spec.key]:
+                        if j != i:
+                            self.layer_params[j] = self._place(
+                                new_ps[n], self._stage_of_layer(j))
         self.grad_acc = [None] * len(self.layers)
 
     # ------------------------------------------------------------- checkpoint
