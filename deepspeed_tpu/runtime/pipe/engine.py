@@ -551,12 +551,58 @@ class PipelineEngine(DeepSpeedEngine):
         if state["losses"]:
             if all(getattr(l, "ndim", 0) == 0 for l in state["losses"]):
                 self.agg_loss = float(
-                    np.mean([float(l) for l in state["losses"]]))
+                    np.mean([self._fetch_scalar(l)
+                             for l in state["losses"]]))
             else:
                 # loss_fn-less eval: expose raw last-stage outputs instead.
                 self.outputs = state["losses"]
                 self.agg_loss = None
         return self.agg_loss
+
+    def _fetch_scalar(self, x):
+        """Host value of a (possibly remote-stage) device scalar. Under
+        multi-controller, the loss lives on the LAST stage's devices —
+        another process cannot float() it. The stage's lowest-ranked
+        controller reads its local (replicated) shard and host-broadcasts
+        it; every process runs this symmetrically, like every other
+        instruction."""
+        if not hasattr(x, "sharding") or jax.process_count() == 1:
+            return float(x)
+        src = sorted(x.sharding.device_set,
+                     key=lambda d: (d.process_index, d.id))
+        # Every predicate below must evaluate IDENTICALLY on all
+        # processes (it is derived from the sharding, not from which
+        # process runs it) — a per-process branch would desync the
+        # symmetric transfer protocol.
+        owners = {d.process_index for d in src}
+        if owners == set(range(jax.process_count())) and \
+                x.sharding.is_fully_replicated:
+            # Every process already holds a replica: pure local reads.
+            return float(np.asarray(x.addressable_shards[0].data))
+        # Cross-host device_put (the same transport the schedule's
+        # Send/Recv instructions ride — ICI/DCN on real pods) onto a
+        # SAME-SIZED device list spread round-robin over every process,
+        # so each controller ends up with a local replica to read. All
+        # processes execute this symmetrically, like every instruction.
+        key = tuple(d.id for d in src)
+        sh = self._fetch_shardings = getattr(self, "_fetch_shardings", {})
+        if key not in sh:
+            by_proc = {}
+            for d in self.mesh.devices.reshape(-1):
+                by_proc.setdefault(d.process_index, []).append(d)
+            picked, i = [], 0
+            while len(picked) < len(src):
+                for p in sorted(by_proc):
+                    if len(picked) < len(src) and i < len(by_proc[p]):
+                        picked.append(by_proc[p][i])
+                i += 1
+            sh[key] = NamedSharding(
+                Mesh(np.asarray(picked), ("replica",)), P())
+        rep = jax.device_put(x, sh[key])
+        shards = rep.addressable_shards
+        assert shards, ("pipeline stage smaller than the process count: "
+                        "no local replica to read the loss from")
+        return float(np.asarray(shards[0].data))
 
     def _dispatch(self, cmd, stage_id, state):
         handler = self._handlers.get(type(cmd))

@@ -20,12 +20,17 @@ Prints one JSON line per scenario. Run on the 8-virtual-device CPU mesh:
 """
 
 import json
+import os
 import time
 from collections import defaultdict
 
 import jax
 
-if jax.default_backend() not in ("cpu", "tpu"):
+# Decide the platform from the ENVIRONMENT, never by initializing a
+# backend: jax.default_backend() dials the tunneled accelerator relay,
+# and on a wedged relay that init blocks forever (seen live, r5) — for
+# a CPU-mesh profile run there is no reason to touch the relay at all.
+if os.environ.get("JAX_PLATFORMS", "") in ("", "cpu"):
     jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
