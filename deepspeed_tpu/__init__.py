@@ -67,7 +67,11 @@ def initialize(args=None,
 
     from deepspeed_tpu.pipe import PipelineModule
     if isinstance(model, PipelineModule):
-        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+        if getattr(model, "compiled", False):
+            from deepspeed_tpu.runtime.pipe.compiled import (
+                CompiledPipelineEngine as PipelineEngine)
+        else:
+            from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
         engine = PipelineEngine(args=args,
                                 model=model,
                                 optimizer=optimizer,

@@ -1,0 +1,515 @@
+"""CompiledPipelineEngine — the ENTIRE pipeline schedule as ONE XLA program.
+
+The instruction-interpreter PipelineEngine (engine.py) preserves the
+reference's per-instruction execution model (reference pipe/engine.py:45-1172
+interprets TrainSchedule commands per rank); its Python dispatch loop is
+fine single-controller but (a) costs host time per instruction and (b)
+cannot drive cross-process stage submeshes in lockstep. This engine is the
+TPU-native alternative: the whole GPipe-style schedule — micro-batch
+wavefront, inter-stage transfers, backward, optimizer — is traced into a
+single jitted SPMD program over a (pipe, data) mesh:
+
+- per-stage block parameters are STACKED on a leading [S] axis sharded
+  over 'pipe', so each stage's weights live only on its pipe slice;
+- one `lax.scan` over M + S - 1 clock ticks advances the micro-batch
+  wavefront; the slab of per-stage activations is sharded
+  P('pipe', 'data'), and the per-tick `jnp.roll` across the pipe axis is
+  compiled by GSPMD into a collective_permute riding ICI — the
+  inter-stage Send/Recv of the reference schedule with zero host
+  involvement;
+- every stage's compute at a tick is a `vmap` over the stacked axis, so
+  XLA schedules all S stage computations of a tick concurrently on their
+  slices (the 1F1B wavefront overlap, enforced by the compiler instead of
+  asynchronous dispatch);
+- the backward is `jax.grad` THROUGH the scan (each tick rematerialized
+  via `jax.checkpoint`), and the optimizer update runs in the same
+  program.
+
+Because it is one global-mesh program, it runs unchanged under
+multi-controller `jax.distributed` — the execution shape of a real
+multi-host pod — where the interpreter cannot.
+
+Constraints (v1): the pipelined run must be STRUCTURALLY UNIFORM — a
+maximal run of identical LayerSpecs divisible by the stage count, with the
+same activation shape in and out. Layers before/after the run (embedding,
+head) execute data-parallel outside the pipelined scan, like the
+first/last-stage extras of a conventional pipeline. TiedLayerSpec is not
+supported here (use the interpreter engine).
+
+Select with ``PipelineModule(..., compiled=True)``.
+"""
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine, _is_flax_module
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, TiedLayerSpec
+from deepspeed_tpu.runtime.utils import ensure_directory_exists
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _spec_key(spec):
+    return (spec.typename, tuple(spec.module_args),
+            tuple(sorted(spec.module_kwargs.items())))
+
+
+def _uniform_run(specs, num_stages):
+    """(i0, i1) of the longest run of identical plain LayerSpecs whose
+    length is a positive multiple of ``num_stages``."""
+    best = None
+    i = 0
+    n = len(specs)
+    while i < n:
+        if not isinstance(specs[i], LayerSpec) or \
+                isinstance(specs[i], TiedLayerSpec):
+            i += 1
+            continue
+        j = i + 1
+        while j < n and isinstance(specs[j], LayerSpec) and \
+                not isinstance(specs[j], TiedLayerSpec) and \
+                _spec_key(specs[j]) == _spec_key(specs[i]):
+            j += 1
+        length = ((j - i) // num_stages) * num_stages
+        if length >= num_stages and (best is None or
+                                     length > best[1] - best[0]):
+            best = (i, i + length)
+        i = j
+    return best
+
+
+class CompiledPipelineEngine(PipelineEngine):
+    """One-program pipeline engine (see module docstring)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        specs = self.pipe_module.layer_specs
+        if any(isinstance(s, TiedLayerSpec) for s in specs):
+            raise ValueError(
+                "compiled pipeline does not support TiedLayerSpec; use "
+                "the interpreter PipelineEngine (compiled=False)")
+        run = _uniform_run(specs, self.num_stages)
+        if run is None:
+            raise ValueError(
+                "compiled pipeline needs a run of >= num_stages identical "
+                "LayerSpecs (a uniform block stack); got {}".format(
+                    [repr(s) for s in specs]))
+        self._run = run
+        self._blocks_per_stage = (run[1] - run[0]) // self.num_stages
+        self._block_module = specs[run[0]].build()
+        self._pro_layers = [self.layers[i] for i in range(run[0])]
+        self._epi_layers = [self.layers[i] for i in range(run[1], len(specs))]
+        self._cp_params = None  # {"prologue": [...], "blocks": st, "epilogue": [...]}
+        self._cp_opt_state = None
+        self._step_fn = None
+        if self.loss_scaler is not None:
+            raise ValueError(
+                "compiled pipeline v1 does not implement fp16 dynamic "
+                "loss scaling (overflow-skip needs host control flow); "
+                "use bf16 or the interpreter engine (compiled=False)")
+        log_dist(
+            "compiled pipeline: {} prologue + {} stages x {} blocks + {} "
+            "epilogue layers, gas={}".format(
+                run[0], self.num_stages, self._blocks_per_stage,
+                len(specs) - run[1], self.micro_batches), ranks=[0])
+
+    # ---------------------------------------------------------- materialize
+
+    def _cp_sharding(self, prefix_spec):
+        return NamedSharding(self.mesh, prefix_spec)
+
+    def _cp_materialize(self, x0):
+        """Init prologue / stacked blocks / epilogue params by threading a
+        probe micro-batch, then place them on the (pipe, data) mesh."""
+        S, L = self.num_stages, self._blocks_per_stage
+        i0, i1 = self._run
+        tm = jax.tree_util.tree_map
+        h = jnp.asarray(x0)
+
+        # EXACTLY the interpreter engine's rng derivation (engine.py
+        # _materialize) — so the two engines build identical params and
+        # their trajectories are directly comparable: a threaded rng,
+        # reseeded per layer (via seed_fn if given) when seed_layers.
+        rng_box = [self._next_rng()]
+
+        def init_layer(idx, layer, probe):
+            rng = rng_box[0]
+            if self.pipe_module.seed_layers:
+                seed = self.pipe_module.base_seed + idx
+                if self.pipe_module.seed_fn is not None:
+                    maybe_key = self.pipe_module.seed_fn(seed)
+                    rng = maybe_key if maybe_key is not None and \
+                        hasattr(maybe_key, "dtype") else \
+                        jax.random.PRNGKey(seed)
+                else:
+                    rng = jax.random.PRNGKey(seed)
+            if not _is_flax_module(layer):
+                rng_box[0] = rng
+                return None
+            # the split happens only for parameterized layers, exactly
+            # like the interpreter's flax branch
+            rng, sub = jax.random.split(rng)
+            rng_box[0] = rng
+            variables = layer.init({"params": sub, "dropout": sub}, probe)
+            return variables.get("params", {})
+
+        pro_params = []
+        for idx, layer in enumerate(self._pro_layers):
+            p = init_layer(idx, layer, h)
+            pro_params.append(p)
+            h = self._cp_apply_layer(layer, p, h)
+        run_shape = h.shape
+
+        block_params = []
+        for s in range(S):
+            per_stage = []
+            for l in range(L):
+                idx = i0 + s * L + l
+                p = init_layer(idx, self._block_module, h)
+                out = self._cp_apply_layer(self._block_module, p, h)
+                assert out.shape == run_shape and out.dtype == h.dtype, (
+                    "compiled pipeline blocks must preserve activation "
+                    "shape/dtype: {} -> {}".format(run_shape, out.shape))
+                h = out
+                per_stage.append(p)
+            block_params.append(per_stage)
+        # stack: leaves [S, L, ...]
+        stacked = tm(lambda *xs: jnp.stack(xs),
+                     *[tm(lambda *ys: jnp.stack(ys), *ps)
+                       for ps in block_params])
+
+        epi_params = []
+        for k, layer in enumerate(self._epi_layers):
+            idx = i1 + k
+            p = init_layer(idx, layer, h)
+            epi_params.append(p)
+            h = self._cp_apply_layer(layer, p, h)
+
+        rep = self._cp_sharding(P())
+        self._cp_params = {
+            "prologue": jax.device_put(pro_params, rep),
+            "blocks": jax.device_put(stacked,
+                                     self._cp_sharding(P("pipe"))),
+            "epilogue": jax.device_put(epi_params, rep),
+        }
+        if self.optimizer is not None:
+            self._cp_opt_state = self._cp_place_state(
+                self.optimizer.init_state(self._cp_params))
+        self._materialized = True
+
+    def _cp_place_state(self, st):
+        """Optimizer-state leaves mirror the param tree one level down
+        ({step, exp_avg{prologue,blocks,epilogue}, ...}); place the blocks
+        branch on 'pipe', everything else replicated."""
+        rep = self._cp_sharding(P())
+        pipe = self._cp_sharding(P("pipe"))
+
+        def place(key, val):
+            if isinstance(val, dict) and "blocks" in val:
+                return {k: jax.device_put(v, pipe if k == "blocks" else rep)
+                        for k, v in val.items()}
+            return jax.device_put(val, rep)
+
+        return {k: place(k, v) for k, v in st.items()}
+
+    @staticmethod
+    def _cp_apply_layer(layer, params, h):
+        if _is_flax_module(layer):
+            return layer.apply({"params": params}, h,
+                               rngs={"dropout": jax.random.PRNGKey(0)})
+        return layer(h)
+
+    # ------------------------------------------------------------- program
+
+    def _cp_build_step(self):
+        mesh = self.mesh
+        S, L, M = self.num_stages, self._blocks_per_stage, self.micro_batches
+        block = self._block_module
+        pro_layers, epi_layers = self._pro_layers, self._epi_layers
+        loss_fn = self.pipe_module.loss_fn
+        opt = self.optimizer
+        tm = jax.tree_util.tree_map
+        cast = self._cast_to_compute
+
+        def csp(x, spec):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec))
+
+        def apply_stage(p_stage, h, rng):
+            # p_stage leaves [L, ...] — the stage's blocks, applied in order.
+            for l in range(L):
+                pl = tm(lambda a: a[l], p_stage)
+                h = block.apply({"params": pl}, h,
+                                rngs={"dropout": jax.random.fold_in(rng, l)})
+            return h
+
+        def loss_of(params, xs, ys, rng):
+            params = cast(params)
+            # xs: [M, mb, ...] micro-batches; prologue is data-parallel.
+            h = xs
+            for layer, p in zip(pro_layers, params["prologue"]):
+                if _is_flax_module(layer):
+                    h = jax.vmap(lambda hm, _l=layer, _p=p: _l.apply(
+                        {"params": _p}, hm,
+                        rngs={"dropout": rng}))(h)
+                else:
+                    h = jax.vmap(layer)(h)
+            h = csp(h, P(None, "data"))
+
+            slab0 = jnp.zeros((S,) + h.shape[1:], h.dtype)
+            out0 = jnp.zeros((M,) + h.shape[1:], h.dtype)
+            bp = params["blocks"]
+
+            def tick(carry, t):
+                slab, outputs = carry
+                # feed the wavefront: micro-batch t enters stage 0
+                new_in = jax.lax.dynamic_index_in_dim(
+                    h, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+                slab = jnp.roll(slab, 1, axis=0)  # GSPMD: collective_permute
+                slab = slab.at[0].set(new_in)
+                slab = csp(slab, P("pipe", "data"))
+                rngs = jax.vmap(
+                    lambda s_: jax.random.fold_in(
+                        jax.random.fold_in(rng, t), s_))(jnp.arange(S))
+                slab = jax.vmap(apply_stage)(bp, slab, rngs)
+                slab = csp(slab, P("pipe", "data"))
+                out_idx = t - (S - 1)
+                upd = jax.lax.dynamic_update_index_in_dim(
+                    outputs, slab[S - 1], jnp.clip(out_idx, 0, M - 1), 0)
+                outputs = jnp.where(out_idx >= 0, upd, outputs)
+                return (slab, outputs), None
+
+            (slab, outputs), _ = jax.lax.scan(
+                jax.checkpoint(tick), (slab0, out0),
+                jnp.arange(M + S - 1))
+            outputs = csp(outputs, P(None, "data"))
+
+            def epi(hm, ym):
+                for layer, p in zip(epi_layers, params["epilogue"]):
+                    if _is_flax_module(layer):
+                        hm = layer.apply({"params": p}, hm,
+                                         rngs={"dropout": rng})
+                    else:
+                        hm = layer(hm)
+                if loss_fn is not None:
+                    return loss_fn(hm, ym)
+                return hm
+
+            losses = jax.vmap(epi)(outputs, ys)
+            return jnp.mean(losses)
+
+        clip = self.gradient_clipping()
+
+        def step(params, opt_state, xs, ys, rng, lr, b1, b2):
+            loss, grads = jax.value_and_grad(loss_of)(params, xs, ys, rng)
+            if clip > 0.0:
+                # global-norm clip across ALL layers, matching the
+                # interpreter's optimizer step (engine.py) — inside the
+                # same program, so it costs one fused reduction.
+                from deepspeed_tpu.runtime.utils import clip_grad_norm_
+                grads, _ = clip_grad_norm_(grads, clip)
+            new_p, new_s = opt.update(params, grads, opt_state, lr=lr,
+                                      betas=(b1, b2))
+            return loss, new_p, new_s
+
+        return jax.jit(
+            step, donate_argnums=(0, 1),
+            out_shardings=(NamedSharding(mesh, P()), None, None))
+
+    # --------------------------------------------------------- train_batch
+
+    def train_batch(self, data_iter=None, batch=None):
+        assert data_iter is not None or batch is not None
+        M = self.micro_batches
+        if batch is not None:
+            xs0, ys0 = np.asarray(batch[0]), np.asarray(batch[1])
+            assert xs0.shape[0] % M == 0
+            mb = xs0.shape[0] // M
+            xs = xs0.reshape((M, mb) + xs0.shape[1:])
+            ys = ys0.reshape((M, mb) + ys0.shape[1:])
+        else:
+            micros = [next(data_iter) for _ in range(M)]
+            xs = np.stack([np.asarray(m[0]) for m in micros])
+            ys = np.stack([np.asarray(m[1]) for m in micros])
+        if not self._materialized:
+            self._cp_materialize(xs[0])
+        xs = jax.device_put(xs, self._cp_sharding(P(None, "data")))
+        ys = jax.device_put(ys, self._cp_sharding(P(None, "data")))
+        if self._step_fn is None:
+            self._step_fn = self._cp_build_step()
+        group = self.optimizer.param_groups[0]
+        lr = jnp.float32(group["lr"])
+        b1, b2 = group.get("betas", (0.9, 0.999))
+        loss, self._cp_params, self._cp_opt_state = self._step_fn(
+            self._cp_params, self._cp_opt_state, xs, ys, self._next_rng(),
+            lr, jnp.float32(b1), jnp.float32(b2))
+
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        if hasattr(self.optimizer, "notify_step"):
+            # freeze bookkeeping (1-bit Adam): the compiled update runs
+            # the degenerate pre-averaged quantization under lax.cond,
+            # so no re-trace is needed at the boundary.
+            self.optimizer.notify_step(self.global_steps -
+                                       self.skipped_steps)
+        self.agg_loss = float(loss)
+        self._last_loss = self.agg_loss
+        self._tensorboard_step_events()
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        if self.global_steps % self.steps_per_print() == 0:
+            self._report_progress(self.global_steps)
+        return self.agg_loss
+
+    def eval_batch(self, data_iter):
+        raise NotImplementedError(
+            "compiled pipeline v1 is a training engine; use the "
+            "interpreter engine for pipelined eval")
+
+    # ---------------------------------------------------------- checkpoint
+
+    def _cp_unstack_tree(self, tree):
+        """{'prologue': [...], 'blocks': [S, L, ...], 'epilogue': [...]}
+        -> per-layer list in PipelineModule layer order — the SAME
+        per-layer layout the interpreter engine uses, so the two engines'
+        checkpoints interchange. Works for params and for each
+        params-shaped optimizer-state branch."""
+        i0, i1 = self._run
+        S, L = self.num_stages, self._blocks_per_stage
+        tm = jax.tree_util.tree_map
+        out = [None] * len(self.pipe_module.layer_specs)
+        for i, p in enumerate(tree["prologue"]):
+            out[i] = p
+        for s in range(S):
+            for l in range(L):
+                out[i0 + s * L + l] = tm(
+                    lambda a, _s=s, _l=l: a[_s, _l], tree["blocks"])
+        for k, p in enumerate(tree["epilogue"]):
+            out[i1 + k] = p
+        return out
+
+    def _cp_restack_tree(self, per_layer):
+        """Inverse of _cp_unstack_tree."""
+        i0, i1 = self._run
+        S, L = self.num_stages, self._blocks_per_stage
+        tm = jax.tree_util.tree_map
+        blocks = tm(lambda *xs: jnp.stack(xs),
+                    *[tm(lambda *ys: jnp.stack(ys),
+                         *[per_layer[i0 + s * L + l] for l in range(L)])
+                      for s in range(S)])
+        return {
+            "prologue": [per_layer[i] for i in range(i0)],
+            "blocks": blocks,
+            "epilogue": [per_layer[i1 + k]
+                         for k in range(len(per_layer) - i1)],
+        }
+
+    def _cp_unstacked(self):
+        return self._cp_unstack_tree(self._cp_params)
+
+    def _cp_per_layer_opt_states(self):
+        """Optimizer state in the INTERPRETER's per-layer-list format
+        (one {step, exp_avg, ...} dict per parameterized layer): scalar
+        state keys are shared across layers, params-shaped keys are
+        unstacked like the params."""
+        per_key = {}
+        for k, v in self._cp_opt_state.items():
+            if isinstance(v, dict) and "blocks" in v:
+                per_key[k] = self._cp_unstack_tree(v)
+            else:
+                per_key[k] = None  # scalar, shared
+        out = []
+        for i, p in enumerate(self._cp_unstacked()):
+            if p is None:
+                out.append(None)
+                continue
+            out.append({k: (self._cp_opt_state[k] if pl is None
+                            else pl[i])
+                        for k, pl in per_key.items()})
+        return out
+
+    def _cp_restack_opt_states(self, saved):
+        """Inverse: a per-layer state list (either engine's save) back to
+        the stacked full-tree state, placed on the mesh."""
+        tm = jax.tree_util.tree_map
+        first = next(s for s in saved if s is not None)
+        st = {}
+        for k, v in first.items():
+            if getattr(v, "ndim", None) == 0 or np.isscalar(v):
+                st[k] = jnp.asarray(v)
+            else:
+                per_layer = [None if s is None else
+                             tm(jnp.asarray, s[k]) for s in saved]
+                st[k] = self._cp_restack_tree(per_layer)
+        return self._cp_place_state(st)
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        if tag is None:
+            tag = "global_step{}".format(self.global_steps)
+        ckpt_dir = os.path.join(save_dir, str(tag))
+        for idx, params in enumerate(self._cp_unstacked()):
+            if params is None:
+                continue
+            path = self.pipe_module.ckpt_layer_path(ckpt_dir, idx)
+            ensure_directory_exists(path)
+            with open(path, "wb") as f:
+                pickle.dump(self._to_host(params), f)
+        if self._cp_opt_state is not None:
+            opt_path = os.path.join(
+                ckpt_dir, "zero_pp_rank_0_mp_rank_00optim_states.pt")
+            ensure_directory_exists(opt_path)
+            with open(opt_path, "wb") as f:
+                # interpreter-format per-layer list — the two engines'
+                # optimizer checkpoints interchange
+                pickle.dump([self._to_host(s) if s is not None else None
+                             for s in self._cp_per_layer_opt_states()], f)
+        self._save_ckpt_meta(ckpt_dir, save_dir, tag, client_state,
+                             save_latest)
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, **kwargs):
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if not os.path.isfile(latest):
+                return None, None
+            with open(latest) as fd:
+                tag = fd.read().strip()
+        ckpt_dir = os.path.join(load_dir, str(tag))
+        assert self._materialized, \
+            "run one train_batch before loading a compiled-pipeline " \
+            "checkpoint so layer shapes exist"
+        tm = jax.tree_util.tree_map
+
+        def load_layer(idx):
+            path = self.pipe_module.ckpt_layer_path(ckpt_dir, idx)
+            if not os.path.exists(path):
+                return None  # parameterless layer: save wrote no file
+            with open(path, "rb") as f:
+                return tm(jnp.asarray, pickle.load(f))
+
+        per_layer = [load_layer(i)
+                     for i in range(len(self.pipe_module.layer_specs))]
+        restacked = self._cp_restack_tree(per_layer)
+        rep = self._cp_sharding(P())
+        self._cp_params = {
+            "prologue": jax.device_put(restacked["prologue"], rep),
+            "blocks": jax.device_put(restacked["blocks"],
+                                     self._cp_sharding(P("pipe"))),
+            "epilogue": jax.device_put(restacked["epilogue"], rep),
+        }
+        opt_path = os.path.join(
+            ckpt_dir, "zero_pp_rank_0_mp_rank_00optim_states.pt")
+        if kwargs.get("load_optimizer_states", True) and \
+                os.path.exists(opt_path):
+            with open(opt_path, "rb") as f:
+                saved = pickle.load(f)
+            if isinstance(saved, list) and any(s is not None
+                                               for s in saved):
+                self._cp_opt_state = self._cp_restack_opt_states(saved)
+        return ckpt_dir, self._load_ckpt_meta(ckpt_dir)

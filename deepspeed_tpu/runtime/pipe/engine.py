@@ -975,6 +975,14 @@ class PipelineEngine(DeepSpeedEngine):
             with open(opt_path, "wb") as f:
                 pickle.dump([self._to_host(s) if s is not None else None
                              for s in self.pipe_opt_state], f)
+        self._save_ckpt_meta(ckpt_dir, save_dir, tag, client_state,
+                             save_latest)
+        return True
+
+    def _save_ckpt_meta(self, ckpt_dir, save_dir, tag, client_state,
+                        save_latest):
+        """Shared meta/'latest' writer for both pipeline engines — one
+        place so the checkpoint header never drifts between them."""
         meta = {
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,
@@ -992,7 +1000,23 @@ class PipelineEngine(DeepSpeedEngine):
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as fd:
                 fd.write(str(tag))
-        return True
+
+    def _load_ckpt_meta(self, ckpt_dir):
+        """Counterpart reader; returns the saved client_state."""
+        meta_path = os.path.join(ckpt_dir, "mp_rank_00_model_states.pt")
+        if not os.path.exists(meta_path):
+            return {}
+        with open(meta_path, "rb") as f:
+            meta = pickle.load(f)
+        self.global_steps = meta.get("global_steps", 0)
+        self.global_samples = meta.get("global_samples", 0)
+        self.skipped_steps = meta.get("skipped_steps", 0)
+        if self.lr_scheduler and meta.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        return {k: v for k, v in meta.items()
+                if k not in ("global_steps", "global_samples",
+                             "skipped_steps", "num_layers", "parts",
+                             "lr_scheduler")}
 
     def load_checkpoint(self, load_dir, tag=None, **kwargs):
         if tag is None:
@@ -1023,21 +1047,7 @@ class PipelineEngine(DeepSpeedEngine):
                 self._place(jax.tree_util.tree_map(jnp.asarray, s),
                             self._stage_of_layer(i)) if s is not None else None
                 for i, s in enumerate(saved)]
-        meta_path = os.path.join(ckpt_dir, "mp_rank_00_model_states.pt")
-        client_state = {}
-        if os.path.exists(meta_path):
-            with open(meta_path, "rb") as f:
-                meta = pickle.load(f)
-            self.global_steps = meta.get("global_steps", 0)
-            self.global_samples = meta.get("global_samples", 0)
-            self.skipped_steps = meta.get("skipped_steps", 0)
-            if self.lr_scheduler and meta.get("lr_scheduler"):
-                self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
-            client_state = {k: v for k, v in meta.items()
-                            if k not in ("global_steps", "global_samples",
-                                         "skipped_steps", "num_layers",
-                                         "parts", "lr_scheduler")}
-        return ckpt_dir, client_state
+        return ckpt_dir, self._load_ckpt_meta(ckpt_dir)
 
 
 def _camel_to_snake(name):

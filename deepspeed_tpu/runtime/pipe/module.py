@@ -84,7 +84,13 @@ class PipelineModule:
                  base_seed=1234,
                  partition_method="parameters",
                  activation_checkpoint_interval=0,
-                 activation_checkpoint_func=None):
+                 activation_checkpoint_func=None,
+                 compiled=False):
+        # compiled=True selects CompiledPipelineEngine (runtime/pipe/
+        # compiled.py): the whole schedule as one XLA program — the
+        # multi-host-capable TPU-native path. Default keeps the
+        # instruction-interpreter engine (reference execution model).
+        self.compiled = compiled
         if num_stages is None and topology is None:
             raise RuntimeError("must provide num_stages or topology")
 

@@ -69,7 +69,9 @@ def main():
     if on_tpu:
         batch, seq, d, n_layers, steps = 8, 1024, 1024, 24, 8
     else:
-        batch, seq, d, n_layers, steps = 4, 128, 64, 4, 3
+        # batch must cover gas=4 x the CPU test mesh's dp=8 in the batch
+        # triangle (micro_batch_per_gpu >= 1).
+        batch, seq, d, n_layers, steps = 32, 128, 64, 4, 3
     cfg = GPT2Config(vocab_size=256, n_positions=seq, n_embd=d,
                      n_layer=n_layers, n_head=max(d // 64, 1), dropout=0.0,
                      use_flash_attention=on_tpu)
@@ -113,6 +115,28 @@ def main():
         results["pipe_pp1_gas{}".format(gas)] = {
             "tokens_per_s": round(tps, 1), "step_s": round(dt, 4)}
 
+    # (d) COMPILED pipeline (runtime/pipe/compiled.py): the whole schedule
+    # as one XLA program, pp=1 single-chip (multi-stage is a mesh story).
+    for gas in (1, 4):
+        model = PipelineModule(
+            layers=[LayerSpec(Block, cfg) for _ in range(n_layers)],
+            num_stages=1, loss_fn=sq_loss, seed_layers=True, base_seed=42,
+            compiled=True)
+        cpipe, _, _, _ = deepspeed.initialize(
+            model=model,
+            config_params={"train_batch_size": batch,
+                           "gradient_accumulation_steps": gas,
+                           "optimizer": opt(),
+                           "bf16": {"enabled": True}})
+        mb = batch // gas
+        micro = [(x[i * mb:(i + 1) * mb], y[i * mb:(i + 1) * mb])
+                 for i in range(gas)]
+        tps, dt = measure(
+            lambda: cpipe.train_batch(data_iter=iter(list(micro))),
+            steps, tokens)
+        results["compiled_pp1_gas{}".format(gas)] = {
+            "tokens_per_s": round(tps, 1), "step_s": round(dt, 4)}
+
     eff = results["pipe_pp1_gas1"]["tokens_per_s"] / plain_tps
     print(json.dumps({
         "metric": "pipe_executor_efficiency_vs_fused",
@@ -120,10 +144,15 @@ def main():
         "unit": "ratio",
         "extra": dict(results, platform=jax.default_backend(),
                       batch=batch, seq=seq, d=d, n_layers=n_layers,
+                      compiled_efficiency=round(
+                          results["compiled_pp1_gas4"]["tokens_per_s"] /
+                          plain_tps, 4),
                       note="pp=1 pipeline vs one fused program, same "
                            "blocks; gas=4 row adds 1F1B micro-batch "
                            "dispatch; recompute backward means the "
-                           "pipeline rows pay ~4/3 the FLOPs"),
+                           "pipeline rows pay ~4/3 the FLOPs; compiled_* "
+                           "rows run the one-program engine "
+                           "(runtime/pipe/compiled.py)"),
     }), flush=True)
 
 

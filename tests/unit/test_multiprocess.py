@@ -173,7 +173,40 @@ dist.init_distributed()
 cfg_name = os.environ["DS_TEST_CONFIG"]
 rng = np.random.RandomState(0)
 
-assert cfg_name == "zero2", cfg_name  # pp goes via the compiled pipeline
+if cfg_name == "pp2_compiled":
+    # Cross-process pipeline parallelism: the compiled engine's single
+    # global-mesh program (runtime/pipe/compiled.py) — per-stage weights
+    # on 'pipe' slices owned by DIFFERENT controllers, inter-stage
+    # handoff as compiled collective permutes.
+    from deepspeed_tpu.models.simple import DenseOut, DenseRelu, ce_loss
+    from deepspeed_tpu.pipe import LayerSpec, PipelineModule
+    model = PipelineModule(
+        layers=[LayerSpec(DenseRelu, 32) for _ in range(4)] +
+               [LayerSpec(DenseOut, 8)],
+        num_stages=2, loss_fn=ce_loss, seed_layers=True, base_seed=42,
+        partition_method="uniform", compiled=True)
+    engine, _, _, _ = deepspeed.initialize(
+        model=model,
+        config_params={
+            "train_batch_size": 16,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        })
+    losses = []
+    for step in range(3):
+        srng = np.random.RandomState(0)
+        data = [(srng.randn(8, 32).astype(np.float32),
+                 srng.randint(0, 8, size=(8,))) for _ in range(2)]
+        losses.append(float(engine.train_batch(data_iter=iter(data))))
+    print("WORKER_RESULT " + json.dumps({
+        "rank": jax.process_index(),
+        "process_count": jax.process_count(),
+        "device_count": jax.device_count(),
+        "losses": losses,
+    }), flush=True)
+    raise SystemExit(0)
+
+assert cfg_name == "zero2", cfg_name
 from deepspeed_tpu.models.simple import SimpleModel
 engine, _, _, _ = deepspeed.initialize(
     model=SimpleModel(hidden_dim=16),
@@ -197,160 +230,6 @@ print("WORKER_RESULT " + json.dumps({
     "process_count": jax.process_count(),
     "device_count": jax.device_count(),
     "local_device_count": jax.local_device_count(),
-    "losses": losses,
-}), flush=True)
-"""
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def _spawn(rank, world_size, port, extra_env=None):
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)  # the worker pins cpu in-process
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.update({
-        "MASTER_ADDR": "127.0.0.1",
-        "MASTER_PORT": str(port),
-        "RANK": str(rank),
-        "WORLD_SIZE": str(world_size),
-        "LOCAL_RANK": "0",
-        # One CPU device per process: the 2-process mesh is 2 devices.
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
-    })
-    env.update(extra_env or {})
-    return subprocess.Popen([sys.executable, "-c", WORKER],
-                            stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True, env=env,
-                            cwd=REPO)
-
-
-def _result(proc, timeout):
-    out, err = proc.communicate(timeout=timeout)
-    assert proc.returncode == 0, \
-        "worker rc={}\nstdout:\n{}\nstderr:\n{}".format(
-            proc.returncode, out[-4000:], err[-4000:])
-    for line in out.splitlines():
-        if line.startswith("WORKER_RESULT "):
-            return json.loads(line[len("WORKER_RESULT "):])
-    raise AssertionError("no WORKER_RESULT in output:\n" + out[-4000:])
-
-
-def test_two_process_bootstrap_and_train():
-    port = _free_port()
-    procs = [_spawn(rank, 2, port) for rank in range(2)]
-    try:
-        results = [_result(p, timeout=420) for p in procs]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-
-    by_rank = {r["rank"]: r for r in results}
-    assert sorted(by_rank) == [0, 1], by_rank
-    for r in results:
-        assert r["process_count"] == 2
-        assert r["device_count"] == 2
-        assert r["local_device_count"] == 1
-        assert all(np.isfinite(r["losses"]))
-    # Both controllers must compute the SAME global program.
-    np.testing.assert_allclose(by_rank[0]["losses"], by_rank[1]["losses"],
-                               rtol=1e-6)
-
-    # Parity with a single process (WORLD_SIZE=1 short-circuits the
-    # rendezvous; same data, same model seed): catches a silently
-    # mis-sharded batch or double-averaged gradient, not just a hang.
-    single = _spawn(0, 1, _free_port())
-    ref = _result(single, timeout=420)
-    assert ref["process_count"] == 1
-    np.testing.assert_allclose(by_rank[0]["losses"], ref["losses"],
-                               rtol=1e-4, atol=1e-5)
-    # Training moved.
-    assert by_rank[0]["losses"][-1] < by_rank[0]["losses"][0]
-
-
-# ---------------------------------------------------------------- sharded
-# VERDICT r4 missing#4: the 2-process rendezvous test proves the bootstrap
-# contract but not a SHARDED PROGRAM SPANNING PROCESSES (the v5e-64
-# execution shape: GSPMD partitioning over devices owned by different
-# controllers). This variant gives each worker 4 virtual CPU devices and
-# runs ZeRO-2 and pp2 configs on the resulting 8-device global mesh,
-# asserting loss parity with the single-process 8-device run that the rest
-# of the suite trusts. Mirrors the intent of the reference's
-# distributed_test fixture (tests/unit/common.py:16-106) with real
-# processes.
-
-SHARDED_WORKER = r"""
-import json
-import os
-
-import jax
-jax.config.update("jax_platforms", "cpu")
-# Cross-stage pipeline transfers are plain device_puts; on real TPU pods
-# they ride ICI/DCN natively, but the CPU backend needs JAX's explicit
-# DCN-transfer server (one socket per process).
-jax.config.update("jax_cross_host_transfer_socket_address",
-                  "127.0.0.1:" + os.environ["DS_TEST_XFER_PORT"])
-
-import numpy as np
-
-import deepspeed_tpu as deepspeed
-from deepspeed_tpu.utils import distributed as dist
-
-dist.init_distributed()
-
-cfg_name = os.environ["DS_TEST_CONFIG"]
-rng = np.random.RandomState(0)
-
-if cfg_name == "zero2":
-    from deepspeed_tpu.models.simple import SimpleModel
-    engine, _, _, _ = deepspeed.initialize(
-        model=SimpleModel(hidden_dim=16),
-        config_params={
-            "train_batch_size": 16,
-            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
-            "bf16": {"enabled": True},
-            "zero_optimization": {"stage": 2},
-        })
-    x = rng.randn(16, 16).astype(np.float32)
-    y = rng.randint(0, 16, size=(16,))
-    losses = []
-    for _ in range(3):
-        loss = engine(x, y)
-        engine.backward(loss)
-        engine.step()
-        losses.append(float(loss))
-else:
-    from deepspeed_tpu.models.simple import DenseOut, DenseRelu, ce_loss
-    from deepspeed_tpu.pipe import LayerSpec, PipelineModule
-    model = PipelineModule(
-        layers=[LayerSpec(DenseRelu, 32), LayerSpec(DenseRelu, 32),
-                LayerSpec(DenseRelu, 32), LayerSpec(DenseOut, 8)],
-        num_stages=2, loss_fn=ce_loss, seed_layers=True, base_seed=42,
-        partition_method="uniform")
-    engine, _, _, _ = deepspeed.initialize(
-        model=model,
-        config_params={
-            "train_batch_size": 16,
-            "gradient_accumulation_steps": 2,
-            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
-        })
-    losses = []
-    for step in range(3):
-        srng = np.random.RandomState(step)
-        data = [(srng.randn(8, 16).astype(np.float32),
-                 srng.randint(0, 8, size=(8,))) for _ in range(2)]
-        losses.append(float(engine.train_batch(data_iter=iter(data))))
-
-print("WORKER_RESULT " + json.dumps({
-    "rank": jax.process_index(),
-    "process_count": jax.process_count(),
-    "device_count": jax.device_count(),
     "losses": losses,
 }), flush=True)
 """
@@ -397,7 +276,7 @@ import pytest
 # desync the two controllers (seen live: gloo key mismatch deadlocks).
 # Cross-process pipeline parallelism is the compiled pipeline's job (one
 # global-mesh program; runtime/pipe/compiled.py) — tested there.
-@pytest.mark.parametrize("cfg", ["zero2"])
+@pytest.mark.parametrize("cfg", ["zero2", "pp2_compiled"])
 def test_two_process_sharded_program_parity(cfg):
     results = _run_sharded(cfg, world_size=2, devices_per_proc=4)
     by_rank = {r["rank"]: r for r in results}
