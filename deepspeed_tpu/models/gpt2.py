@@ -264,3 +264,128 @@ def _sequence_parallel_xent(x, wte, labels, cfg, axis):
 def create_model(config=None, **kw):
     config = config or GPT2Config(**kw)
     return GPT2LMHeadModel(config)
+
+
+# ------------------------------------------------------- pipeline variant
+
+class GPT2PipeEmbed(nn.Module):
+    """Pipeline stage 0: token + position embedding (the reference's
+    EmbeddingPipe, megatron-style first stage). Exposes ``wte`` so a
+    TiedLayerSpec can reuse it as the LM head."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.n_embd), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.n_positions, cfg.n_embd), jnp.float32)
+        T = input_ids.shape[1]
+        x = wte.astype(cfg.dtype)[input_ids] + \
+            wpe.astype(cfg.dtype)[:T][None]
+        # train/eval is signaled by dropout-rng PRESENCE: the pipeline
+        # engines pass a dropout rng only on training forwards.
+        return nn.Dropout(cfg.dropout)(
+            x, deterministic=not self.has_rng("dropout"))
+
+
+class GPT2PipeBlock(nn.Module):
+    """One transformer block as a pipeline layer (the uniform run the
+    compiled engine stacks over its 'pipe' axis)."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        return Block(self.config)(x, not self.has_rng("dropout"))
+
+
+class GPT2PipeFinal(nn.Module):
+    """Final LayerNorm + UNTIED LM head producing fp32 logits. Untied so
+    the compiled engine (which rejects cross-stage tied params) can run
+    it; the tied variant reuses GPT2PipeEmbed via TiedLayerSpec."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         name="ln_f")(x)
+        head = self.param("lm_head", nn.initializers.normal(0.02),
+                          (cfg.vocab_size, cfg.n_embd), jnp.float32)
+        # (hidden, head) tuple — the loss_fn runs the CHUNKED tied-decoder
+        # softmax-xent so [B,T,V] logits are never materialized (same
+        # reason GPT2LMHeadModel routes through chunked_tied_softmax_xent).
+        return x, head
+
+
+def _gpt2_tied_head(layer, params, x):
+    """TiedLayerSpec.forward_fn: final norm lives in the PREVIOUS layer;
+    this reuse hands the embedding stage's wte to the chunked loss."""
+    return x, params["wte"]
+
+
+class GPT2PipeLN(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.LayerNorm(epsilon=self.config.layer_norm_epsilon,
+                            dtype=self.config.dtype, name="ln_f")(x)
+
+
+def gpt2_lm_loss(out, labels):
+    """Shifted softmax-xent for the pipeline head (the loss_fn slot of
+    PipelineModule; reference pipeline models pass CrossEntropy the same
+    way). Takes the final stage's (hidden, head) tuple and runs the
+    CHUNKED tied-decoder loss so full logits never hit HBM; a plain
+    logits array is also accepted."""
+    if isinstance(out, (tuple, list)):
+        x, head = out
+        return _chunked_softmax_xent(x[:, :-1], head, labels[:, 1:],
+                                     x.dtype)
+    v = out.shape[-1]
+    lg = out[:, :-1].reshape(-1, v)
+    lb = labels[:, 1:].reshape(-1)
+    lse = jax.scipy.special.logsumexp(lg, axis=-1)
+    gold = jnp.take_along_axis(lg, lb[:, None], axis=1)[:, 0]
+    return jnp.mean(lse - gold)
+
+
+def gpt2_pipeline(config=None, num_stages=2, tied=None, compiled=False,
+                  partition_method="uniform", **kw):
+    """GPT-2 as a PipelineModule: embed prologue, n_layer uniform blocks,
+    final-LN+head epilogue (the reference's GPT2ModelPipe shape:
+    Megatron_GPT2 pipeline examples).
+
+    tied=True (default for the interpreter engine) shares the embedding
+    with the LM head via TiedLayerSpec; compiled=True forces the untied
+    head (the one-program engine keeps per-stage params on disjoint pipe
+    slices, so cross-stage sharing is structurally excluded).
+    """
+    from deepspeed_tpu.pipe import (LayerSpec, PipelineModule,
+                                    TiedLayerSpec)
+    cfg = config or GPT2Config(**kw)
+    if tied is None:
+        tied = not compiled
+    if compiled and tied:
+        raise ValueError("compiled GPT-2 pipeline requires tied=False")
+    if compiled and cfg.use_flash_attention:
+        # The compiled engine vmaps the block over the stacked stage axis;
+        # the flash kernel's custom_partitioning wrapper has no batching
+        # rule, so pipelined blocks use the dense (XLA) attention path.
+        import dataclasses
+        cfg = dataclasses.replace(cfg, use_flash_attention=False)
+    blocks = [LayerSpec(GPT2PipeBlock, cfg) for _ in range(cfg.n_layer)]
+    if tied:
+        layers = ([TiedLayerSpec("embed", GPT2PipeEmbed, cfg)] + blocks +
+                  [LayerSpec(GPT2PipeLN, cfg),
+                   TiedLayerSpec("embed", GPT2PipeEmbed, cfg,
+                                 forward_fn=_gpt2_tied_head)])
+    else:
+        layers = ([LayerSpec(GPT2PipeEmbed, cfg)] + blocks +
+                  [LayerSpec(GPT2PipeFinal, cfg)])
+    return PipelineModule(layers=layers, num_stages=num_stages,
+                          loss_fn=gpt2_lm_loss, seed_layers=True,
+                          partition_method=partition_method,
+                          compiled=compiled)

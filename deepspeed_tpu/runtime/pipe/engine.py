@@ -324,16 +324,19 @@ class PipelineEngine(DeepSpeedEngine):
         self._stage_bwd_local[stage_id] = jitted
         return jitted
 
-    def _get_stage_fn(self, stage_id):
+    def _get_stage_fn(self, stage_id, with_dropout=True):
         """One jitted function running all of a stage's layers; last stage
-        appends the loss_fn. Returns (out_or_loss, ...)."""
-        if stage_id in self._stage_fwd:
-            return self._stage_fwd[stage_id]
-        jitted = jax.jit(self._build_stage_fn(stage_id))
-        self._stage_fwd[stage_id] = jitted
+        appends the loss_fn. Returns (out_or_loss, ...). ``with_dropout``
+        False (eval) omits the dropout rng — layers keying train/eval on
+        rng presence (has_rng) then run deterministically."""
+        key = (stage_id, with_dropout)
+        if key in self._stage_fwd:
+            return self._stage_fwd[key]
+        jitted = jax.jit(self._build_stage_fn(stage_id, with_dropout))
+        self._stage_fwd[key] = jitted
         return jitted
 
-    def _build_stage_fn(self, stage_id):
+    def _build_stage_fn(self, stage_id, with_dropout=True):
         """The raw (unjitted) stage function — shared by the eval path
         (_get_stage_fn jits it directly) and the training path
         (_get_stage_fwd_bwd differentiates it under jit)."""
@@ -353,7 +356,9 @@ class PipelineEngine(DeepSpeedEngine):
             elif _is_flax_module(layer):
                 apply_layer_fns.append(
                     lambda p, x, rng, _l=layer:
-                    _l.apply({"params": p}, x, rngs={"dropout": rng}))
+                    _l.apply({"params": p}, x,
+                             rngs={"dropout": rng} if with_dropout
+                             else {}))
             else:
                 apply_layer_fns.append(lambda p, x, rng, _l=layer: _l(x))
 
@@ -656,7 +661,11 @@ class PipelineEngine(DeepSpeedEngine):
             # intermediate — see _get_stage_fwd_bwd.
             buf["vjp"][cmd.buffer_id] = (params_list, x, labels, rng)
         else:
-            out = self._get_stage_fn(stage_id)(params_list, x, labels, rng)
+            # eval: no dropout rng — layers keying on has_rng("dropout")
+            # run deterministically (the reference eval_batch flips
+            # module.eval() the same way).
+            out = self._get_stage_fn(stage_id, with_dropout=False)(
+                params_list, x, labels, rng)
         buf["outputs"][cmd.buffer_id] = out
         if stage_id == self.num_stages - 1:
             # Reference semantics (pipe/engine.py:537-543): with a loss_fn the

@@ -198,9 +198,23 @@ def run_stage(name, argv, timeout, env_extra):
     return rc == 0
 
 
+# Time-boxed triage tiers (VERDICT r4 next#1) for SHORT relay windows:
+# tier a (~10 min) banks the fresh-hash headline on bundled tiles — the
+# one number that moves vs_baseline; tier b (~30 min) adds the autotune
+# resweep + the kernel A/Bs; tier c is everything. Tiers are cumulative.
+TIERS = {
+    "a": ["smoke", "headline"],
+    "b": ["smoke", "headline", "autotune", "headline_tuned",
+          "headline_remat", "headline_splitbwd"],
+    "c": [s[0] for s in STAGES],
+}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--budget", type=float, default=6 * 3600)
+    ap.add_argument("--tier", choices=sorted(TIERS),
+                    help="short-window triage preset (overrides --stages)")
     ap.add_argument("--stages", default=",".join(s[0] for s in STAGES))
     ap.add_argument("--fresh", action="store_true",
                     help="ignore battery_results.json passes from a "
@@ -209,7 +223,10 @@ def main():
                          "every stage)")
     args = ap.parse_args()
     os.makedirs(RUNS, exist_ok=True)
-    want = [s.strip() for s in args.stages.split(",") if s.strip()]
+    if args.tier:
+        want = list(TIERS[args.tier])
+    else:
+        want = [s.strip() for s in args.stages.split(",") if s.strip()]
     known = {s[0] for s in STAGES}
     unknown = sorted(set(want) - known)
     if unknown:
