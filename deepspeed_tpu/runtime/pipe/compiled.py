@@ -112,6 +112,13 @@ class CompiledPipelineEngine(PipelineEngine):
                 "compiled pipeline v1 does not implement fp16 dynamic "
                 "loss scaling (overflow-skip needs host control flow); "
                 "use bf16 or the interpreter engine (compiled=False)")
+        if self.zero_optimization() and self.zero_optimization_stage() >= 2:
+            raise ValueError(
+                "compiled pipeline v1 composes PP with ZeRO stage 1 "
+                "(moments sharded over each stage's data replicas); "
+                "stage {} grad/param sharding is not implemented — use "
+                "stage 1 or the base engine".format(
+                    self.zero_optimization_stage()))
         log_dist(
             "compiled pipeline: {} prologue + {} stages x {} blocks + {} "
             "epilogue layers, gas={}".format(
@@ -202,17 +209,45 @@ class CompiledPipelineEngine(PipelineEngine):
                 self.optimizer.init_state(self._cp_params))
         self._materialized = True
 
+    def _cp_blocks_state_sharding(self, leaf):
+        """Sharding for a stacked-blocks optimizer-state leaf [S, L, ...]:
+        'pipe' on the stage axis always; with ZeRO enabled, additionally
+        shard the largest trailing param dim over 'data' — fp32 moments
+        are the bulk of optimizer memory, and partitioning them over the
+        stage's data replicas is exactly ZeRO-1 composed with PP (the
+        update runs sharded; GSPMD all-gathers the new params, the same
+        exchange ZeRO-1 pays)."""
+        spec = [mesh_lib.PIPE_AXIS] + [None] * (leaf.ndim - 1)
+        if self.zero_optimization():
+            dp = self.mesh.shape.get(mesh_lib.DATA_AXIS, 1)
+            if dp > 1:
+                # same dim policy as mesh_lib.zero_shardings' leaf_spec
+                # (first divisible dim of size >= dp), applied past the
+                # [S, L] stacking prefix this engine adds.
+                for d in range(2, leaf.ndim):
+                    if leaf.shape[d] % dp == 0 and leaf.shape[d] >= dp:
+                        spec[d] = mesh_lib.DATA_AXIS
+                        break
+        return self._cp_sharding(P(*spec))
+
     def _cp_place_state(self, st):
         """Optimizer-state leaves mirror the param tree one level down
         ({step, exp_avg{prologue,blocks,epilogue}, ...}); place the blocks
-        branch on 'pipe', everything else replicated."""
+        branch on 'pipe' (+ ZeRO 'data' sharding, see above), everything
+        else replicated."""
         rep = self._cp_sharding(P())
-        pipe = self._cp_sharding(P("pipe"))
+        tm = jax.tree_util.tree_map
 
         def place(key, val):
             if isinstance(val, dict) and "blocks" in val:
-                return {k: jax.device_put(v, pipe if k == "blocks" else rep)
-                        for k, v in val.items()}
+                out = {}
+                for k, v in val.items():
+                    if k == "blocks":
+                        out[k] = tm(lambda leaf: jax.device_put(
+                            leaf, self._cp_blocks_state_sharding(leaf)), v)
+                    else:
+                        out[k] = jax.device_put(v, rep)
+                return out
             return jax.device_put(val, rep)
 
         return {k: place(k, v) for k, v in st.items()}
@@ -317,9 +352,16 @@ class CompiledPipelineEngine(PipelineEngine):
                                       betas=(b1, b2))
             return loss, new_p, new_s
 
+        # Pin the output shardings to the materialized layouts — without
+        # this GSPMD may silently replicate the ZeRO-sharded moments on
+        # the first step's output and the memory saving evaporates.
+        params_sh = jax.tree_util.tree_map(
+            lambda x: x.sharding, self._cp_params)
+        state_sh = jax.tree_util.tree_map(
+            lambda x: x.sharding, self._cp_opt_state)
         return jax.jit(
             step, donate_argnums=(0, 1),
-            out_shardings=(NamedSharding(mesh, P()), None, None))
+            out_shardings=(NamedSharding(mesh, P()), params_sh, state_sh))
 
     # --------------------------------------------------------- train_batch
 
