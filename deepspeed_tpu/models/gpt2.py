@@ -370,12 +370,9 @@ def gpt2_pipeline(config=None, num_stages=2, tied=None, compiled=False,
         tied = not compiled
     if compiled and tied:
         raise ValueError("compiled GPT-2 pipeline requires tied=False")
-    if compiled and cfg.use_flash_attention:
-        # The compiled engine vmaps the block over the stacked stage axis;
-        # the flash kernel's custom_partitioning wrapper has no batching
-        # rule, so pipelined blocks use the dense (XLA) attention path.
-        import dataclasses
-        cfg = dataclasses.replace(cfg, use_flash_attention=False)
+    # (Flash attention works in compiled pipelines: the engine's
+    # shard_map worker runs blocks shard-locally and flash entry points
+    # launch raw pallas kernels under the shard_local_kernels context.)
     blocks = [LayerSpec(GPT2PipeBlock, cfg) for _ in range(cfg.n_layer)]
     if tied:
         layers = ([TiedLayerSpec("embed", GPT2PipeEmbed, cfg)] + blocks +

@@ -51,8 +51,10 @@ exercises the same code path (tests mirror reference
 tests/unit/test_cuda_forward.py / test_cuda_backward.py grids).
 """
 
+import contextlib
 import functools
 import os
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -902,8 +904,28 @@ def _flash_bwd_pallas(q, k, v, mask, delta, lse, g, scale, causal, block_q,
 # ---------------------------------------------------------------------------
 
 
+_shard_local = threading.local()
+
+
+@contextlib.contextmanager
+def shard_local_kernels():
+    """Within this context, flash entry points skip the
+    custom_partitioning wrapper and launch the raw pallas kernels —
+    for callers that are ALREADY inside a manual-sharding region
+    (shard_map), where every array is shard-local and GSPMD has nothing
+    to partition (custom_partitioning is not usable there). Thread-local
+    and re-entrant; only tracing cares."""
+    prev = getattr(_shard_local, "on", False)
+    _shard_local.on = True
+    try:
+        yield
+    finally:
+        _shard_local.on = prev
+
+
 def _use_custom_partitioning():
-    return os.environ.get("DS_TPU_NO_CUSTOM_PARTITION", "0") != "1"
+    return os.environ.get("DS_TPU_NO_CUSTOM_PARTITION", "0") != "1" \
+        and not getattr(_shard_local, "on", False)
 
 
 def _bh_spec(sharding):

@@ -93,6 +93,15 @@ class CompiledPipelineEngine(PipelineEngine):
             raise ValueError(
                 "compiled pipeline does not support TiedLayerSpec; use "
                 "the interpreter PipelineEngine (compiled=False)")
+        pp = self.mesh.shape.get(mesh_lib.PIPE_AXIS, 1)
+        if pp != self.num_stages:
+            raise ValueError(
+                "compiled pipeline needs a mesh whose 'pipe' axis equals "
+                "num_stages (got pipe={}, num_stages={}): with fewer "
+                "devices than stages the shard_map worker would silently "
+                "drop stages. Provide enough devices (device_count "
+                "divisible by num_stages) or a matching mesh.".format(
+                    pp, self.num_stages))
         run = _uniform_run(specs, self.num_stages)
         if run is None:
             raise ValueError(
@@ -283,6 +292,71 @@ class CompiledPipelineEngine(PipelineEngine):
                                 rngs={"dropout": jax.random.fold_in(rng, l)})
             return h
 
+        from jax import shard_map
+
+        axis_p, axis_d = mesh_lib.PIPE_AXIS, mesh_lib.DATA_AXIS
+        ring = [(i, (i + 1) % S) for i in range(S)]
+
+        def worker(bp, epi_params, h, ys, rng):
+            """Manual-sharding pipeline body: one pipe shard per stage,
+            batch sharded over 'data'. The inter-stage handoff is an
+            EXPLICIT jax.lax.ppermute riding ICI; the per-stage compute is
+            the SAME function on every shard (SPMD), with this shard's
+            [1, L, ...] block slice. Inside shard_map arrays are
+            shard-local, so blocks launch the raw pallas flash kernels
+            (shard_local_kernels — scoped HERE so GSPMD-region callers
+            like the prologue keep their partitioning wrappers)."""
+            from deepspeed_tpu.ops.transformer.kernels.attention import (
+                shard_local_kernels)
+            with shard_local_kernels():
+                return _worker_body(bp, epi_params, h, ys, rng)
+
+        def _worker_body(bp, epi_params, h, ys, rng):
+            sidx = jax.lax.axis_index(axis_p)
+            p_stage = tm(lambda a: a[0], bp)
+            slab0 = jnp.zeros(h.shape[1:], h.dtype)   # [mb_loc, ...]
+            out0 = jnp.zeros_like(h)                  # [M, mb_loc, ...]
+
+            def tick(carry, t):
+                slab, outputs = carry
+                # handoff: stage s's output becomes stage s+1's input;
+                # stage 0 instead ingests micro-batch t (bubble ticks
+                # feed a clamped repeat whose results are masked off).
+                prev = jax.lax.ppermute(slab, axis_p, ring)
+                new_in = jax.lax.dynamic_index_in_dim(
+                    h, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+                cur = jnp.where(sidx == 0, new_in, prev)
+                srng = jax.random.fold_in(jax.random.fold_in(rng, t), sidx)
+                cur = apply_stage(p_stage, cur, srng)
+                out_idx = t - (S - 1)
+                upd = jax.lax.dynamic_update_index_in_dim(
+                    outputs, cur, jnp.clip(out_idx, 0, M - 1), 0)
+                outputs = jnp.where((out_idx >= 0) & (sidx == S - 1),
+                                    upd, outputs)
+                return (cur, outputs), None
+
+            (_, outputs), _ = jax.lax.scan(
+                jax.checkpoint(tick), (slab0, out0),
+                jnp.arange(M + S - 1))
+
+            def epi(hm, ym):
+                for layer, p in zip(epi_layers, epi_params):
+                    if _is_flax_module(layer):
+                        hm = layer.apply({"params": p}, hm,
+                                         rngs={"dropout": rng})
+                    else:
+                        hm = layer(hm)
+                if loss_fn is not None:
+                    return loss_fn(hm, ym)
+                return hm
+
+            # Non-last shards ran the epilogue on zeros; only the last
+            # stage's loss counts (summed over the one live shard), then
+            # batch-averaged over the data axis.
+            losses = jax.vmap(epi)(outputs, ys)
+            local = jnp.where(sidx == S - 1, jnp.mean(losses), 0.0)
+            return jax.lax.pmean(jax.lax.psum(local, axis_p), axis_d)
+
         def loss_of(params, xs, ys, rng):
             params = cast(params)
             # xs: [M, mb, ...] micro-batches; prologue is data-parallel.
@@ -295,48 +369,13 @@ class CompiledPipelineEngine(PipelineEngine):
                 else:
                     h = jax.vmap(layer)(h)
             h = csp(h, P(None, "data"))
-
-            slab0 = jnp.zeros((S,) + h.shape[1:], h.dtype)
-            out0 = jnp.zeros((M,) + h.shape[1:], h.dtype)
-            bp = params["blocks"]
-
-            def tick(carry, t):
-                slab, outputs = carry
-                # feed the wavefront: micro-batch t enters stage 0
-                new_in = jax.lax.dynamic_index_in_dim(
-                    h, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-                slab = jnp.roll(slab, 1, axis=0)  # GSPMD: collective_permute
-                slab = slab.at[0].set(new_in)
-                slab = csp(slab, P("pipe", "data"))
-                rngs = jax.vmap(
-                    lambda s_: jax.random.fold_in(
-                        jax.random.fold_in(rng, t), s_))(jnp.arange(S))
-                slab = jax.vmap(apply_stage)(bp, slab, rngs)
-                slab = csp(slab, P("pipe", "data"))
-                out_idx = t - (S - 1)
-                upd = jax.lax.dynamic_update_index_in_dim(
-                    outputs, slab[S - 1], jnp.clip(out_idx, 0, M - 1), 0)
-                outputs = jnp.where(out_idx >= 0, upd, outputs)
-                return (slab, outputs), None
-
-            (slab, outputs), _ = jax.lax.scan(
-                jax.checkpoint(tick), (slab0, out0),
-                jnp.arange(M + S - 1))
-            outputs = csp(outputs, P(None, "data"))
-
-            def epi(hm, ym):
-                for layer, p in zip(epi_layers, params["epilogue"]):
-                    if _is_flax_module(layer):
-                        hm = layer.apply({"params": p}, hm,
-                                         rngs={"dropout": rng})
-                    else:
-                        hm = layer(hm)
-                if loss_fn is not None:
-                    return loss_fn(hm, ym)
-                return hm
-
-            losses = jax.vmap(epi)(outputs, ys)
-            return jnp.mean(losses)
+            return shard_map(
+                worker, mesh=mesh,
+                in_specs=(P(axis_p), P(), P(None, axis_d),
+                          P(None, axis_d), P()),
+                out_specs=P(),
+                check_vma=False)(params["blocks"], params["epilogue"],
+                                 h, ys, rng)
 
         clip = self.gradient_clipping()
 
@@ -388,8 +427,8 @@ class CompiledPipelineEngine(PipelineEngine):
         lr = jnp.float32(group["lr"])
         b1, b2 = group.get("betas", (0.9, 0.999))
         loss, self._cp_params, self._cp_opt_state = self._step_fn(
-            self._cp_params, self._cp_opt_state, xs, ys, self._next_rng(),
-            lr, jnp.float32(b1), jnp.float32(b2))
+            self._cp_params, self._cp_opt_state, xs, ys,
+            self._next_rng(), lr, jnp.float32(b1), jnp.float32(b2))
 
         self.global_steps += 1
         self.global_samples += self.train_batch_size()

@@ -117,6 +117,8 @@ def main():
 
     # (d) COMPILED pipeline (runtime/pipe/compiled.py): the whole schedule
     # as one XLA program, pp=1 single-chip (multi-stage is a mesh story).
+    # Same cfg as the interpreter rows — flash included (the shard_map
+    # worker launches raw pallas kernels).
     for gas in (1, 4):
         model = PipelineModule(
             layers=[LayerSpec(Block, cfg) for _ in range(n_layers)],
@@ -152,7 +154,7 @@ def main():
                            "dispatch; recompute backward means the "
                            "pipeline rows pay ~4/3 the FLOPs; compiled_* "
                            "rows run the one-program engine "
-                           "(runtime/pipe/compiled.py)"),
+                           "(runtime/pipe/compiled.py), same kernels"),
     }), flush=True)
 
 
