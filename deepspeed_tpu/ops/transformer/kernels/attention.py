@@ -1005,8 +1005,8 @@ def _bwd_partitioned(has_mask, scale, causal, block_q, block_k):
     return _cp_wrap(f, 6, 3, rule)
 
 
-def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k):
-    if _use_custom_partitioning():
+def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k, raw=False):
+    if not raw and _use_custom_partitioning():
         f = _fwd_partitioned(mask is not None, scale, causal,
                              block_q, block_k)
         args = (q, k, v) if mask is None else (q, k, v, mask)
@@ -1014,7 +1014,8 @@ def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k):
     return _flash_fwd_pallas(q, k, v, mask, scale, causal, block_q, block_k)
 
 
-def _flash_bwd(res, g, scale, causal, block_q, block_k, dlse=None):
+def _flash_bwd(res, g, scale, causal, block_q, block_k, dlse=None,
+               raw=False):
     q, k, v, mask, o, lse = res
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
@@ -1022,7 +1023,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, dlse=None):
         # An lse cotangent folds into the same kernels: dlse_i/ds_ij = p_ij,
         # so ds = p * (dp - (delta - dlse)) — a pure delta shift.
         delta = delta - dlse
-    if _use_custom_partitioning():
+    if not raw and _use_custom_partitioning():
         f = _bwd_partitioned(mask is not None, scale, causal,
                              block_q, block_k)
         args = (q, k, v, delta, lse, g) if mask is None else \
@@ -1039,39 +1040,51 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, dlse=None):
 # Public entry point
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_attention(q, k, v, mask, scale, causal, block_q, block_k):
-    o, _ = _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k)
+# ``raw`` (shard-local) is a STATIC nondiff arg captured at the public
+# entry: the custom_vjp backward is traced lazily at transpose time —
+# possibly after the shard_local_kernels context has exited — so the
+# decision must ride the residual-free static args, not the thread-local.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention(q, k, v, mask, scale, causal, block_q, block_k, raw):
+    o, _ = _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k,
+                      raw=raw)
     return o
 
 
-def _flash_attention_fwd(q, k, v, mask, scale, causal, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k)
+def _flash_attention_fwd(q, k, v, mask, scale, causal, block_q, block_k,
+                         raw):
+    o, lse = _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k,
+                        raw=raw)
     return o, (q, k, v, mask, o, lse)
 
 
-def _flash_attention_bwd(scale, causal, block_q, block_k, res, g):
-    return _flash_bwd(res, g, scale, causal, block_q, block_k)
+def _flash_attention_bwd(scale, causal, block_q, block_k, raw, res, g):
+    return _flash_bwd(res, g, scale, causal, block_q, block_k, raw=raw)
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_attention_lse(q, k, v, mask, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention_lse(q, k, v, mask, scale, causal, block_q, block_k,
+                         raw):
     """(o, lse) variant — lse is differentiable too (ring attention merges
     partial results through it)."""
-    return _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k)
+    return _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k,
+                      raw=raw)
 
 
-def _flash_attention_lse_fwd(q, k, v, mask, scale, causal, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k)
+def _flash_attention_lse_fwd(q, k, v, mask, scale, causal, block_q,
+                             block_k, raw):
+    o, lse = _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k,
+                        raw=raw)
     return (o, lse), (q, k, v, mask, o, lse)
 
 
-def _flash_attention_lse_bwd(scale, causal, block_q, block_k, res, g):
+def _flash_attention_lse_bwd(scale, causal, block_q, block_k, raw, res, g):
     do, dlse = g
-    return _flash_bwd(res, do, scale, causal, block_q, block_k, dlse=dlse)
+    return _flash_bwd(res, do, scale, causal, block_q, block_k, dlse=dlse,
+                      raw=raw)
 
 
 _flash_attention_lse.defvjp(_flash_attention_lse_fwd,
@@ -1091,7 +1104,8 @@ def flash_attention_with_lse(q, k, v, mask=None, causal=False, scale=None,
         return mha_reference(q, k, v, mask=mask, causal=causal,
                              scale=scale, return_lse=True)
     return _flash_attention_lse(q, k, v, mask, float(scale), bool(causal),
-                                block_q, block_k)
+                                block_q, block_k,
+                                not _use_custom_partitioning())
 
 
 def flash_signature(b, h, t_q, t_kv, d, dtype, causal):
@@ -1140,8 +1154,9 @@ def _autotuned_blocks(q, k, v, causal, default_q, default_k):
             def once(carry, _):
                 x_, y_, z_ = carry
                 g = jax.grad(lambda a, b_, c: _flash_attention(
-                    a, b_, c, None, 1.0 / d ** 0.5, bool(causal), bq, bk
-                ).astype(jnp.float32).sum(), argnums=(0, 1, 2))(x_, y_, z_)
+                    a, b_, c, None, 1.0 / d ** 0.5, bool(causal), bq, bk,
+                    False).astype(jnp.float32).sum(),
+                    argnums=(0, 1, 2))(x_, y_, z_)
                 return (x_ + g[0] * eps, y_ + g[1] * eps,
                         z_ + g[2] * eps), None
 
@@ -1205,4 +1220,5 @@ def flash_attention(q, k, v, mask=None, causal=False, scale=None,
         # ops/transformer/transformer.py:183-193).
         return mha_reference(q, k, v, mask=mask, causal=causal, scale=scale)
     return _flash_attention(q, k, v, mask, float(scale), bool(causal),
-                            block_q, block_k)
+                            block_q, block_k,
+                            not _use_custom_partitioning())

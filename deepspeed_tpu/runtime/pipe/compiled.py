@@ -295,7 +295,10 @@ class CompiledPipelineEngine(PipelineEngine):
         from jax import shard_map
 
         axis_p, axis_d = mesh_lib.PIPE_AXIS, mesh_lib.DATA_AXIS
-        ring = [(i, (i + 1) % S) for i in range(S)]
+        # No wraparound edge: stage 0 always takes the fresh micro-batch,
+        # so shipping stage S-1's slab back to 0 would be pure wasted
+        # traffic on the longest link; missing sources deliver zeros.
+        ring = [(i, i + 1) for i in range(S - 1)]
 
         def worker(bp, epi_params, h, ys, rng):
             """Manual-sharding pipeline body: one pipe shard per stage,
