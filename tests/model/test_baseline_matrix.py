@@ -149,10 +149,11 @@ def _pipe_model(num_stages, gas=1):
     return model
 
 
-def run_pipe_config(pp, tp=1, gas=1, steps=STEPS):
+def run_pipe_config(pp, tp=1, gas=1, steps=STEPS, model=None):
     from deepspeed_tpu.parallel import mesh as mesh_lib
 
-    model = _pipe_model(num_stages=pp, gas=gas)
+    if model is None:
+        model = _pipe_model(num_stages=pp, gas=gas)
     mesh = None
     if tp > 1:
         mesh = mesh_lib.build_mesh(num_pp=pp, num_mp=tp,
@@ -189,32 +190,35 @@ def _load(name):
         return json.load(f)
 
 
-def _check(curve, baseline_name):
-    base = np.asarray(_load(baseline_name)["losses"], np.float64)
+def _compare_curves(curve, base, prefix_rtol=RTOL, prefix_atol=ATOL):
+    """Pointwise tracking for the pre-chaotic prefix: through ~step 12 the
+    trajectory is stable and a real plumbing bug (wrong grad scale,
+    dropped psum) shows up immediately. Beyond that, bf16 +
+    sharded-summation-order differences legitimately butterfly into
+    different single-step spike patterns (the serial baseline itself
+    spikes near step ~20), so the tail is compared on a 5-step running
+    mean — trajectory-level tracking that still catches divergence or
+    non-learning, without failing on a one-step spike landing one index
+    apart between two correct implementations. Plus a learning gate: a
+    healthy run drops ~30% over the 30 steps (9.79 -> ~6.8); an optimizer
+    or gradient plumbing break flatlines and trips it even if some future
+    baseline regen were to flatline too."""
+    base = np.asarray(base, np.float64)
     curve = np.asarray(curve, np.float64)
-    # Pointwise tracking for the pre-chaotic prefix: through ~step 12 the
-    # trajectory is stable and a real plumbing bug (wrong grad scale,
-    # dropped psum) shows up immediately. Beyond that, bf16 +
-    # sharded-summation-order differences legitimately butterfly into
-    # different single-step spike patterns (the serial baseline itself
-    # spikes near step ~20), so the tail is compared on a 5-step running
-    # mean — trajectory-level tracking that still catches divergence or
-    # non-learning, without failing on a one-step spike landing one index
-    # apart between two correct implementations.
     strict = min(12, len(base))
     np.testing.assert_allclose(curve[:strict], base[:strict],
-                               rtol=RTOL, atol=ATOL)
+                               rtol=prefix_rtol, atol=prefix_atol)
 
     def smooth(x, w=5):
         return np.convolve(x, np.ones(w) / w, mode="valid")
 
     np.testing.assert_allclose(smooth(curve), smooth(base),
                                rtol=RTOL, atol=ATOL)
-    # Learning gate on top of the tracking check: a healthy run drops
-    # ~30% over the 30 steps (9.79 -> ~6.8); an optimizer or gradient
-    # plumbing break flatlines and trips this even if some future
-    # baseline regen were to flatline too.
     assert curve[-1] < 0.75 * curve[0], curve[-5:]
+
+
+def _check(curve, baseline_name):
+    _compare_curves(curve, _load(baseline_name)["losses"])
 
 
 # --- the matrix -------------------------------------------------------------
@@ -252,6 +256,27 @@ def test_pipe_serial_matches_committed_baseline():
                                        (4, 1, 1)])
 def test_pipe_matrix_tracks_baseline(pp, tp, gas):
     _check(run_pipe_config(pp=pp, tp=tp, gas=gas), "gpt2_13m_pipe_serial")
+
+
+def test_pipe_compiled_matches_interpreter_untied():
+    """Model-tier engine-equivalence: the COMPILED pipeline engine
+    (runtime/pipe/compiled.py — whole schedule as one XLA program) must
+    track the interpreter engine at 13M-param scale under the matrix's
+    training config (bf16, global clip, AdamW, gas=2), both driving the
+    same UNTIED gpt2_pipeline model. Prefix tolerance is tighter than the
+    baseline-drift bar: the two runs share data and config, differing
+    only by engine (bf16 reduction order differs between the two
+    programs, so bitwise equality is not expected)."""
+    from deepspeed_tpu.models.gpt2 import gpt2_pipeline
+
+    def run(compiled):
+        model = gpt2_pipeline(
+            _mid_cfg(use_flash_attention=False), num_stages=2,
+            tied=False, compiled=compiled, partition_method="uniform")
+        return run_pipe_config(pp=2, gas=2, model=model)
+
+    lc, li = run(True), run(False)
+    _compare_curves(lc, li, prefix_rtol=5e-3, prefix_atol=5e-3)
 
 
 def _regen():
