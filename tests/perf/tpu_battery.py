@@ -72,9 +72,6 @@ STAGES = [
     ("smoke", ["-c", SMOKE], 1200, {}),
     ("headline", ["bench.py"], 2400,
      {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1"}),
-    ("headline_remat", ["bench.py"], 2400,
-     {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1",
-      "DS_BENCH_NO_RECORD": "1", "DS_TPU_XE_HEAD": "remat"}),
     ("headline_splitbwd", ["bench.py"], 2400,
      {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1",
       "DS_BENCH_NO_RECORD": "1", "DS_TPU_FLASH_BWD": "split"}),
@@ -104,6 +101,13 @@ STAGES = [
     ("decode", ["tests/perf/decode_bench.py"], 1800,
      {"DS_BENCH_REQUIRE_TPU": "1"}),
     ("capacity", ["tests/perf/capacity_probe.py"], 10800, {}),
+    # DEAD LAST: the remat-head A/B hung in compile for its full window
+    # live in r5 and its timeout-kill wedged the relay for hours. It is
+    # an optimization experiment, not evidence — nothing may queue
+    # behind it, so a hang/kill/wedge here costs only this stage.
+    ("headline_remat", ["bench.py"], 2400,
+     {"DS_BENCH_INNER": "1", "DS_BENCH_REQUIRE_TPU": "1",
+      "DS_BENCH_NO_RECORD": "1", "DS_TPU_XE_HEAD": "remat"}),
 ]
 
 
