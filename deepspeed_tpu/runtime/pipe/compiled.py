@@ -270,15 +270,21 @@ class CompiledPipelineEngine(PipelineEngine):
 
     # ------------------------------------------------------------- program
 
-    def _cp_build_step(self):
+    def _cp_build_loss(self, dropout=True):
+        """The pipelined loss program (shared by the training step and
+        eval). ``dropout`` False omits every dropout rng — layers keying
+        train/eval on has_rng then run deterministically, mirroring the
+        interpreter's eval forwards."""
         mesh = self.mesh
         S, L, M = self.num_stages, self._blocks_per_stage, self.micro_batches
         block = self._block_module
         pro_layers, epi_layers = self._pro_layers, self._epi_layers
         loss_fn = self.pipe_module.loss_fn
-        opt = self.optimizer
         tm = jax.tree_util.tree_map
         cast = self._cast_to_compute
+
+        def rngs_of(key):
+            return {"dropout": key} if dropout else {}
 
         def csp(x, spec):
             return jax.lax.with_sharding_constraint(
@@ -289,7 +295,7 @@ class CompiledPipelineEngine(PipelineEngine):
             for l in range(L):
                 pl = tm(lambda a: a[l], p_stage)
                 h = block.apply({"params": pl}, h,
-                                rngs={"dropout": jax.random.fold_in(rng, l)})
+                                rngs=rngs_of(jax.random.fold_in(rng, l)))
             return h
 
         from jax import shard_map
@@ -346,7 +352,7 @@ class CompiledPipelineEngine(PipelineEngine):
                 for layer, p in zip(epi_layers, epi_params):
                     if _is_flax_module(layer):
                         hm = layer.apply({"params": p}, hm,
-                                         rngs={"dropout": rng})
+                                         rngs=rngs_of(rng))
                     else:
                         hm = layer(hm)
                 if loss_fn is not None:
@@ -368,7 +374,7 @@ class CompiledPipelineEngine(PipelineEngine):
                 if _is_flax_module(layer):
                     h = jax.vmap(lambda hm, _l=layer, _p=p: _l.apply(
                         {"params": _p}, hm,
-                        rngs={"dropout": rng}))(h)
+                        rngs=rngs_of(rng)))(h)
                 else:
                     h = jax.vmap(layer)(h)
             h = csp(h, P(None, "data"))
@@ -380,6 +386,12 @@ class CompiledPipelineEngine(PipelineEngine):
                 check_vma=False)(params["blocks"], params["epilogue"],
                                  h, ys, rng)
 
+        return loss_of
+
+    def _cp_build_step(self):
+        mesh = self.mesh
+        opt = self.optimizer
+        loss_of = self._cp_build_loss(dropout=True)
         clip = self.gradient_clipping()
 
         def step(params, opt_state, xs, ys, rng, lr, b1, b2):
@@ -407,8 +419,10 @@ class CompiledPipelineEngine(PipelineEngine):
 
     # --------------------------------------------------------- train_batch
 
-    def train_batch(self, data_iter=None, batch=None):
-        assert data_iter is not None or batch is not None
+    def _cp_stage_batch(self, data_iter, batch):
+        """Collect gas micro-batches (from the iterator or by splitting a
+        directly-passed global batch), materialize on first contact, and
+        stage [M, mb, ...] onto the mesh — shared by train and eval."""
         M = self.micro_batches
         if batch is not None:
             xs0, ys0 = np.asarray(batch[0]), np.asarray(batch[1])
@@ -424,6 +438,11 @@ class CompiledPipelineEngine(PipelineEngine):
             self._cp_materialize(xs[0])
         xs = jax.device_put(xs, self._cp_sharding(P(None, "data")))
         ys = jax.device_put(ys, self._cp_sharding(P(None, "data")))
+        return xs, ys
+
+    def train_batch(self, data_iter=None, batch=None):
+        assert data_iter is not None or batch is not None
+        xs, ys = self._cp_stage_batch(data_iter, batch)
         if self._step_fn is None:
             self._step_fn = self._cp_build_step()
         group = self.optimizer.param_groups[0]
@@ -451,9 +470,22 @@ class CompiledPipelineEngine(PipelineEngine):
         return self.agg_loss
 
     def eval_batch(self, data_iter):
-        raise NotImplementedError(
-            "compiled pipeline v1 is a training engine; use the "
-            "interpreter engine for pipelined eval")
+        """Pipelined evaluation: the same one-program schedule, forward
+        only, with no dropout rngs (deterministic — matches the
+        interpreter's eval_batch contract)."""
+        if self.pipe_module.loss_fn is None:
+            raise NotImplementedError(
+                "compiled eval_batch needs a loss_fn (the interpreter "
+                "engine's loss_fn-less eval exposes raw outputs; this "
+                "engine's one-program schedule reduces to a scalar)")
+        xs, ys = self._cp_stage_batch(data_iter, None)
+        if getattr(self, "_eval_fn", None) is None:
+            self._eval_fn = jax.jit(
+                self._cp_build_loss(dropout=False),
+                out_shardings=NamedSharding(self.mesh, P()))
+        self.agg_loss = float(self._eval_fn(
+            self._cp_params, xs, ys, jax.random.PRNGKey(0)))
+        return self.agg_loss
 
     # ---------------------------------------------------------- checkpoint
 

@@ -249,3 +249,39 @@ def test_gpt2_pipeline_compiled_flash_matches_dense(eight_devices):
     lf, ld = run(True), run(False)
     np.testing.assert_allclose(lf, ld, rtol=5e-3, atol=1e-3)
     assert lf[-1] < lf[0]
+
+
+def test_compiled_eval_batch_deterministic_and_matches_interpreter(
+        eight_devices, tmp_path):
+    """eval_batch on the compiled engine: forward-only one-program
+    schedule, deterministic under dropout, and — through a checkpoint
+    interchange onto the interpreter engine — numerically equal to the
+    interpreter's eval of the same params."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, gpt2_pipeline
+
+    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=4,
+                     n_head=4, dropout=0.1, use_flash_attention=False)
+
+    def mk(compiled):
+        model = gpt2_pipeline(cfg, num_stages=2, tied=False,
+                              compiled=compiled)
+        return deepspeed.initialize(model=model, config_params={
+            "train_batch_size": 8, "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})[0]
+
+    comp = mk(True)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 256, size=(8, 32))
+    micro = [(ids[:4], ids[:4]), (ids[4:], ids[4:])]
+    for _ in range(2):
+        comp.train_batch(data_iter=iter(list(micro)))
+    e1 = comp.eval_batch(iter(list(micro)))
+    e2 = comp.eval_batch(iter(list(micro)))
+    assert e1 == e2, "compiled eval not deterministic under dropout"
+
+    comp.save_checkpoint(str(tmp_path / "ck"))
+    interp = mk(False)
+    interp.train_batch(data_iter=iter(list(micro)))  # materialize
+    interp.load_checkpoint(str(tmp_path / "ck"))
+    ei = interp.eval_batch(iter(list(micro)))
+    np.testing.assert_allclose(e1, ei, rtol=2e-4, atol=1e-5)
