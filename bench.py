@@ -242,6 +242,8 @@ _FALLBACK_METRIC_FOR = {
         "bert_large_tokens_per_sec_per_chip",
     "bert_tiny_sparse_tokens_per_sec_per_chip":
         "bert_large_sparse_tokens_per_sec_per_chip",
+    "gpt2_tiny_serving_tokens_per_sec":
+        "gpt2_355m_serving_tokens_per_sec",
 }
 
 
@@ -674,6 +676,132 @@ def _measure_bert(sparse, steps):
     })
 
 
+def _measure_serving(smoke=False):
+    """Continuous-batching serving benchmark (deepspeed_tpu/inference/).
+
+    A synthetic Poisson request stream plays against the slotted engine:
+    requests arrive at exponential inter-arrival times, admit into free
+    slots at chunk boundaries, and decode concurrently. Reports tok/s,
+    p50/p99 per-token decode latency and time-to-first-token, and slot
+    occupancy; ``vs_baseline`` is the throughput ratio against serving
+    the SAME requests one at a time through models.generation.generate —
+    the continuous-batching win itself. ``smoke`` runs the tiny model
+    with a short stream (the tier-1 in-process mode)."""
+    import jax
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models.generation import generate
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu" and not smoke
+    if on_tpu:
+        cfg = GPT2Config.gpt2_medium(dropout=0.0, use_flash_attention=True)
+        n_req, rate = 48, 16.0           # requests, arrivals/sec
+        serve_cfg = {"max_slots": 16, "max_len": 1024, "chunk_size": 16,
+                     "max_queue": n_req}
+        prompt_lens, max_new = (64, 256), 96
+    else:
+        # Tiny smoke stream: a fast arrival rate so the run is bounded by
+        # decode, not by simulated arrival gaps.
+        cfg = GPT2Config.tiny(dropout=0.0, use_flash_attention=False)
+        n_req, rate = 10, 500.0
+        serve_cfg = {"max_slots": 4, "max_len": 64, "chunk_size": 4,
+                     "prefill_buckets": (16,), "max_queue": n_req}
+        prompt_lens, max_new = (4, 12), 8
+
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(0, cfg.vocab_size, size=(2, 16))
+    import jax.numpy as jnp
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(init_ids))["params"]
+    engine = deepspeed.init_inference(
+        model=model, params=params, config={"inference": serve_cfg})
+
+    # The stream: lengths from a SMALL set (each distinct length is one
+    # sequential-baseline compile; the engine itself buckets them).
+    lens = [int(prompt_lens[i % len(prompt_lens)]) for i in range(n_req)]
+    prompts = [rng.randint(0, cfg.vocab_size, size=(n,)).astype(np.int32)
+               for n in lens]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+
+    # Warmup: one request per distinct bucket compiles every prefill
+    # program + the decode program; the timed stream then runs at the
+    # engine's zero-recompile steady state.
+    engine.generate([prompts[lens.index(n)] for n in sorted(set(lens))],
+                    max_new_tokens=2)
+    warm_compiles = engine.compile_count
+
+    t0 = time.time()
+    submitted, reqs, done = 0, [], []
+    while len(done) < n_req:
+        now = time.time() - t0
+        while submitted < n_req and arrivals[submitted] <= now:
+            reqs.append(engine.submit(prompts[submitted],
+                                      max_new_tokens=max_new))
+            submitted += 1
+        if engine._scheduler.idle:
+            time.sleep(max(arrivals[submitted] - (time.time() - t0), 0.0))
+            continue
+        done.extend(engine.step())
+    wall = max(time.time() - t0, 1e-9)
+
+    toks_out = sum(len(r.tokens) for r in reqs)
+    ttft = [r.first_token_time - r.submit_time for r in reqs]
+    per_tok = [(r.finish_time - r.first_token_time) /
+               max(len(r.tokens) - 1, 1) for r in reqs]
+    m = engine.metrics()
+
+    # Sequential baseline: the same prompts, one at a time, greedy — the
+    # pre-continuous-batching serving story. Warm each distinct length
+    # first so both sides are timed at their compiled steady state.
+    for n in sorted(set(lens)):
+        generate(model, params, prompts[lens.index(n)][None], max_new,
+                 temperature=0.0)
+    tb = time.time()
+    for p in prompts:
+        np.asarray(generate(model, params, p[None], max_new,
+                            temperature=0.0))
+    seq_wall = max(time.time() - tb, 1e-9)
+    seq_tok_per_sec = toks_out / seq_wall
+    tok_per_sec = toks_out / wall
+
+    return {
+        "metric": "gpt2_{}_serving_tokens_per_sec".format(
+            "355m" if on_tpu else "tiny_smoke" if smoke else "tiny"),
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tok_per_sec / seq_tok_per_sec, 4),
+        "extra": {
+            "platform": platform,
+            "requests": n_req,
+            "arrival_rate_per_sec": rate,
+            "max_new_tokens": max_new,
+            "tokens_out": toks_out,
+            "p50_per_token_latency_ms": round(
+                float(np.percentile(per_tok, 50)) * 1e3, 3),
+            "p99_per_token_latency_ms": round(
+                float(np.percentile(per_tok, 99)) * 1e3, 3),
+            "p50_ttft_ms": round(float(np.percentile(ttft, 50)) * 1e3, 3),
+            "p99_ttft_ms": round(float(np.percentile(ttft, 99)) * 1e3, 3),
+            "slot_occupancy": round(m["slot_occupancy"], 4),
+            "sequential_tokens_per_sec": round(seq_tok_per_sec, 1),
+            "compile_count": m["compile_count"],
+            "recompiles_after_warmup": m["compile_count"] - warm_compiles,
+            "max_slots": serve_cfg["max_slots"],
+            "chunk_size": serve_cfg["chunk_size"],
+        },
+    }
+
+
+def main_serve(smoke=False):
+    if not smoke:
+        _require_tpu_or_exit()
+    _emit(_measure_serving(smoke=smoke))
+    return 0
+
+
 def main_bert(sparse=False):
     _require_tpu_or_exit()
     _measure_bert(sparse=sparse, steps=12)
@@ -708,6 +836,10 @@ def main_sweep():
 
 
 def _dispatch(argv):
+    if "--serve-smoke" in argv:
+        return main_serve(smoke=True)
+    if "--serve" in argv:
+        return main_serve()
     if "--sweep" in argv:
         return main_sweep()
     if "--xl-compute" in argv:

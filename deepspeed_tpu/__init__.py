@@ -105,6 +105,30 @@ def initialize(args=None,
     return tuple(return_items)
 
 
+def init_inference(model=None, params=None, config=None, mesh=None):
+    """Initialize the serving engine (the reference's
+    ``deepspeed.init_inference`` shape, which v0.3.10 does not have —
+    its only inference surface is pipelined eval_batch).
+
+    ``model`` is a GPT2LMHeadModel (or its config); ``params`` the trained
+    pytree. ``config`` may be an ``InferenceConfig``, a bare ``inference``
+    block dict, a full ds_config dict carrying an ``"inference"`` key, or
+    a parsed ``DeepSpeedConfig``. Extra TPU-only kwarg: ``mesh`` — pass a
+    mesh with a 'model' axis to serve a tensor-sharded model.
+
+    Returns the ``InferenceEngine``.
+    """
+    from deepspeed_tpu.inference import InferenceConfig, InferenceEngine
+
+    assert model is not None, "init_inference requires a model"
+    assert params is not None, "init_inference requires trained params"
+    if isinstance(config, DeepSpeedConfig):
+        config = InferenceConfig.from_dict(config.inference)
+    elif isinstance(config, dict) and "inference" in config:
+        config = InferenceConfig.from_dict(config["inference"])
+    return InferenceEngine(model, params, config=config, mesh=mesh)
+
+
 def _add_core_arguments(parser):
     """Core DeepSpeed argparse group (reference __init__.py:142-190)."""
     group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")

@@ -1,0 +1,18 @@
+"""deepspeed_tpu.inference — continuous-batching serving engine.
+
+Beyond the v0.3.10 reference (whose only inference surface is pipelined
+``eval_batch``; SURVEY: no ``deepspeed.inference`` module): a slotted
+KV-cache pool (kv_pool), a chunked decode program shared with
+``models.generation`` (engine), and an Orca-style chunk-boundary
+scheduler (scheduler). Entry points: ``deepspeed_tpu.init_inference``
+or ``InferenceEngine`` directly.
+"""
+
+from deepspeed_tpu.inference.config import InferenceConfig  # noqa: F401
+from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: F401
+from deepspeed_tpu.inference.kv_pool import init_pool, kv_spec  # noqa: F401
+from deepspeed_tpu.inference.scheduler import (  # noqa: F401
+    QueueFull,
+    Request,
+    Scheduler,
+)

@@ -1,0 +1,118 @@
+"""InferenceConfig — the serving engine's knob surface.
+
+Mirrors the runtime side's declarative config style (runtime/config.py):
+one dataclass, one ``from_dict`` that rejects unknown keys (a typo like
+``"max_slot"`` must not silently serve with defaults), and validation
+against the model's position budget at engine construction.
+
+Every field is a COMPILE-SHAPE knob or a host-side policy knob — nothing
+here varies per request (per-request sampling params travel as traced
+device values, see engine.py), which is what bounds the compile count:
+one prefill program per prompt bucket + one decode-chunk program, total.
+"""
+
+import dataclasses
+from typing import Optional, Tuple
+
+# The JSON block under "inference" in ds_config (runtime/config.py reads
+# it with these defaults; InferenceConfig.from_dict consumes the result).
+INFERENCE_DEFAULTS = {
+    "max_slots": 8,
+    "max_len": 512,
+    "chunk_size": 16,
+    "prefill_buckets": None,
+    "max_queue": 64,
+    "eos_token_id": None,
+    "max_new_tokens": 128,
+}
+
+
+def default_buckets(max_len):
+    """Power-of-two prompt buckets up to ``max_len``: each admitted prompt
+    pads to the smallest covering bucket, so prefill compiles at most
+    log2(max_len) programs regardless of prompt-length mix."""
+    buckets = []
+    b = 16
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return tuple(buckets)
+
+
+@dataclasses.dataclass(frozen=True)
+class InferenceConfig:
+    # Fixed number of concurrently-decoding sequences: the batch dim of
+    # the KV pool. Batch composition changes by slot assignment, never by
+    # reshaping, so the decode program compiles exactly once.
+    max_slots: int = 8
+    # KV-cache length per slot; prompt_len + max_new_tokens must fit.
+    max_len: int = 512
+    # Tokens decoded per jitted chunk (one lax.scan trip count). Admission
+    # and eviction happen only at chunk boundaries: larger chunks amortize
+    # dispatch, smaller chunks cut admission latency.
+    chunk_size: int = 16
+    # Prompt-length buckets for prefill padding (sorted ascending). None
+    # derives power-of-two buckets from max_len.
+    prefill_buckets: Optional[Tuple[int, ...]] = None
+    # Queued (not yet admitted) request cap — submit() raises QueueFull
+    # beyond it. The backpressure boundary for upstream callers.
+    max_queue: int = 64
+    # Default EOS id for requests that don't specify one (None: no EOS,
+    # sequences run to max_new_tokens).
+    eos_token_id: Optional[int] = None
+    # Default per-request new-token budget.
+    max_new_tokens: int = 128
+
+    def __post_init__(self):
+        if self.max_slots < 1:
+            raise ValueError("inference.max_slots must be >= 1, got "
+                             "{}".format(self.max_slots))
+        if self.chunk_size < 1:
+            raise ValueError("inference.chunk_size must be >= 1, got "
+                             "{}".format(self.chunk_size))
+        if self.max_queue < 1:
+            raise ValueError("inference.max_queue must be >= 1, got "
+                             "{}".format(self.max_queue))
+        buckets = self.prefill_buckets
+        if buckets is None:
+            buckets = default_buckets(self.max_len)
+        buckets = tuple(sorted(int(b) for b in buckets))
+        if not buckets or buckets[-1] > self.max_len:
+            raise ValueError(
+                "inference.prefill_buckets {} must be non-empty and <= "
+                "max_len={}".format(buckets, self.max_len))
+        object.__setattr__(self, "prefill_buckets", buckets)
+
+    @classmethod
+    def from_dict(cls, block):
+        """Build from a ds_config ``inference`` block (or any dict with the
+        same keys). Unknown keys raise — the block is the public config
+        contract and typos must be loud."""
+        block = dict(block or {})
+        unknown = set(block) - set(INFERENCE_DEFAULTS)
+        if unknown:
+            raise ValueError(
+                "unknown inference config key(s) {}; valid keys: {}".format(
+                    sorted(unknown), sorted(INFERENCE_DEFAULTS)))
+        merged = dict(INFERENCE_DEFAULTS, **block)
+        if merged["prefill_buckets"] is not None:
+            merged["prefill_buckets"] = tuple(merged["prefill_buckets"])
+        return cls(**merged)
+
+    def bucket_for(self, prompt_len):
+        """Smallest prefill bucket covering ``prompt_len`` (ValueError when
+        the prompt exceeds every bucket)."""
+        for b in self.prefill_buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            "prompt of {} tokens exceeds the largest prefill bucket {} "
+            "(max_len={})".format(prompt_len, self.prefill_buckets[-1],
+                                  self.max_len))
+
+    def validate_against_model(self, n_positions):
+        if self.max_len > n_positions:
+            raise ValueError(
+                "inference.max_len={} exceeds the model's n_positions={}"
+                .format(self.max_len, n_positions))

@@ -1,0 +1,339 @@
+"""InferenceEngine — continuous-batching serving over the slotted KV pool.
+
+Two jitted programs serve every request mix (the compile-count contract
+docs/INFERENCE.md pins and tests/unit/test_inference.py asserts):
+
+- PREFILL (one compile per prompt bucket): slice one slot's k/v planes
+  out of the pool, run the batched prompt pass (``models.generation``'s
+  ``_forward`` — MXU-sized GEMMs over the padded bucket), write the slot
+  back, sample the first token, and install the request's per-slot state.
+  The slot index, true prompt length and sampling params are all TRACED,
+  so any request lands in any slot under the same program.
+
+- DECODE CHUNK (one compile, ever): advance ALL slots ``chunk_size``
+  tokens via one ``lax.scan`` over ``models.generation.decode_step``.
+  Inactive slots are frozen — their pos is pinned and emissions masked —
+  exactly the trick ``generate`` uses for early-EOS rows, so occupancy
+  changes never change the program.
+
+The host loop (``step()``) runs the Orca cycle at chunk boundaries:
+admit queued requests into free slots (prefill), decode one chunk,
+harvest emitted tokens, evict finished slots. Under greedy decoding the
+emitted tokens are token-identical to sequential ``generate`` calls —
+both drive the same decode step program (models/generation.py).
+
+Tensor parallelism: pass a mesh with a 'model' axis — params shard by
+DEFAULT_TP_RULES (parallel/mesh.py), the KV pool shards its heads dim to
+match, and both programs pin their out_shardings so the cache layout
+survives every step. One engine, sharded or not.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.config import InferenceConfig
+from deepspeed_tpu.inference.kv_pool import (
+    cache_view,
+    init_pool,
+    pool_shardings,
+    shard_pool,
+)
+from deepspeed_tpu.inference.scheduler import Scheduler
+from deepspeed_tpu.models import generation
+from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+
+_NEG = None  # set lazily: jnp.finfo(jnp.float32).min
+
+
+def _neg():
+    global _NEG
+    if _NEG is None:
+        _NEG = jnp.finfo(jnp.float32).min
+    return _NEG
+
+
+def _sample_rows(logits, temp, top_k, seed, position):
+    """Per-row sampling over [R, V] fp32 logits with PER-ROW params (all
+    traced — a new temperature/top_k mix never recompiles). temp<=0 is
+    greedy and bit-identical to ``generate``'s argmax; top_k<=0 disables
+    the top-k filter. The rng is derived as fold_in(PRNGKey(seed), pos):
+    a (request seed, token position) pair names each draw, independent of
+    slot placement or chunk boundaries."""
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+    # kth-largest per row with a TRACED k: sort once, gather the kth.
+    srt = jnp.sort(logits, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        srt, jnp.clip(top_k - 1, 0, V - 1)[:, None], axis=1)
+    masked = jnp.where((top_k[:, None] > 0) & (logits < kth), _neg(), logits)
+    scaled = masked / jnp.maximum(temp, 1e-6)[:, None]
+    keys = jax.vmap(lambda s, p: jax.random.fold_in(
+        jax.random.PRNGKey(s), p))(seed, position)
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+    return jnp.where(temp > 0.0, sampled, greedy).astype(jnp.int32)
+
+
+# --------------------------------------------------------------- programs
+#
+# Module-level pure functions; each engine wraps them in its OWN jax.jit
+# so per-engine compile counters (_cache_size) stay honest.
+
+
+def _prefill_program(params, gcfg, pool, prompt, prompt_len, slot,
+                     max_new, eos_id, temp, top_k, seed):
+    """Admit one request into ``slot``. ``prompt`` is [1, bucket] (padded
+    right; pad ids are arbitrary — their logits are never read and their
+    k/v writes sit beyond the frontier). Returns (pool', first_token)."""
+    ks = jax.lax.dynamic_slice_in_dim(pool["k"], slot, 1, axis=1)
+    vs = jax.lax.dynamic_slice_in_dim(pool["v"], slot, 1, axis=1)
+    cache = {"k": ks, "v": vs, "pos": jnp.zeros((1,), jnp.int32)}
+    logits, cache = generation._forward(params, gcfg, prompt, cache)
+    last = logits[0, prompt_len - 1]                    # true last row [V]
+    first = _sample_rows(last[None], temp[None], top_k[None], seed[None],
+                         prompt_len[None])[0]
+    pool = dict(pool)
+    pool["k"] = jax.lax.dynamic_update_slice_in_dim(
+        pool["k"], cache["k"], slot, axis=1)
+    pool["v"] = jax.lax.dynamic_update_slice_in_dim(
+        pool["v"], cache["v"], slot, axis=1)
+    # The first token counts against the budget; a request can finish at
+    # admission (max_new==1, or its first token IS its EOS).
+    finished = (max_new <= 1) | ((eos_id >= 0) & (first == eos_id))
+    for name, val in (("pos", prompt_len), ("last_tok", first),
+                      ("active", ~finished), ("remaining", max_new - 1),
+                      ("eos", eos_id), ("temp", temp), ("top_k", top_k),
+                      ("seed", seed)):
+        pool[name] = pool[name].at[slot].set(val)
+    return pool, first
+
+
+def _decode_chunk_program(params, gcfg, chunk, pool):
+    """Advance every ACTIVE slot ``chunk`` tokens in one scan. Returns
+    (pool', tokens [chunk, slots], valid [chunk, slots]) — valid[t, s]
+    marks slot s as active at step t, i.e. tokens[t, s] belongs to its
+    request. Frozen slots still flow through decode_step (the static
+    shape requires it) but their pos is pinned and writes land at their
+    frozen frontier, where the next admission overwrites them before any
+    causal mask can see them."""
+
+    def step(pool, _):
+        was_active = pool["active"]
+        old_pos = pool["pos"]
+        logits, cache = generation.decode_step(
+            params, gcfg, pool["last_tok"], cache_view(pool))
+        nxt = _sample_rows(logits, pool["temp"], pool["top_k"],
+                           pool["seed"], cache["pos"])
+        nxt = jnp.where(was_active, nxt, pool["last_tok"])
+        hit_eos = (pool["eos"] >= 0) & (nxt == pool["eos"])
+        remaining = jnp.where(was_active, pool["remaining"] - 1,
+                              pool["remaining"])
+        pool = dict(pool, k=cache["k"], v=cache["v"],
+                    pos=jnp.where(was_active, cache["pos"], old_pos),
+                    last_tok=nxt,
+                    active=was_active & ~hit_eos & (remaining > 0),
+                    remaining=remaining)
+        emit = jnp.where(was_active, nxt, -1)
+        return pool, (emit, was_active)
+
+    pool, (toks, valid) = jax.lax.scan(step, pool, None, length=chunk)
+    return pool, toks, valid
+
+
+class InferenceEngine(object):
+    """Continuous-batching serving engine (see module docstring).
+
+    ``model`` is a GPT2LMHeadModel (or its config); ``params`` the trained
+    tree (``engine.params`` or a checkpoint). ``config`` an
+    InferenceConfig / dict / None; ``mesh`` an optional jax mesh for
+    tensor-sharded serving.
+    """
+
+    def __init__(self, model, params, config=None, mesh=None):
+        if config is None:
+            config = InferenceConfig()
+        elif isinstance(config, dict):
+            config = InferenceConfig.from_dict(config)
+        self.config = config
+        self._gcfg = generation.as_gencfg(getattr(model, "config", model))
+        config.validate_against_model(self._gcfg.n_positions)
+        self.mesh = mesh
+        self._scheduler = Scheduler(config.max_slots, config.max_queue)
+
+        pool = init_pool(self._gcfg, config.max_slots, config.max_len)
+        if mesh is not None and mesh_lib.mp_size(mesh) > 1:
+            param_sh, _, _ = mesh_lib.zero_shardings(mesh, params, stage=0)
+            params = jax.tree_util.tree_map(jax.device_put, params, param_sh)
+            pool = shard_pool(mesh, pool, self._gcfg.n_head)
+            pool_out = pool_shardings(mesh, pool, self._gcfg.n_head)
+            rep = mesh_lib.replicated(mesh)
+            prefill_out = (pool_out, rep)
+            decode_out = (pool_out, rep, rep)
+        else:
+            prefill_out = decode_out = None
+        self._params = params
+        self._pool = pool
+
+        # Per-engine jit instances: their _cache_size() IS the compile
+        # counter the zero-recompile guarantee is asserted against. The
+        # functools.partial wrapper gives each engine a distinct callable
+        # — jax's pjit cache is keyed on the underlying function, so two
+        # engines jitting the bare program would pool their cache entries
+        # and the counter would read other engines' compiles. Donating
+        # the pool threads one cache allocation through every program
+        # call instead of double-buffering gigabytes of k/v.
+        self._prefill = jax.jit(
+            functools.partial(_prefill_program), static_argnums=(1,),
+            donate_argnums=(2,), out_shardings=prefill_out)
+        self._decode = jax.jit(
+            functools.partial(_decode_chunk_program), static_argnums=(1, 2),
+            donate_argnums=(3,), out_shardings=decode_out)
+
+        self.timers = SynchronizedWallClockTimer()
+        self.counters = {
+            "tokens_out": 0, "chunks": 0, "prefills": 0,
+            "requests_completed": 0, "occupied_slot_steps": 0,
+            "slot_steps": 0,
+        }
+        self._t0 = time.time()
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, prompt, max_new_tokens=None, temperature=0.0,
+               top_k=None, eos_token_id=None, seed=0):
+        """Queue one request; returns its Request handle. Raises
+        scheduler.QueueFull past ``max_queue`` pending requests
+        (backpressure) and ValueError when the request cannot fit the
+        pool's static shapes (no silent truncation)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens is None:
+            max_new_tokens = self.config.max_new_tokens
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.config.bucket_for(prompt.size)  # raises when over-long
+        if prompt.size + max_new_tokens > self.config.max_len:
+            raise ValueError(
+                "prompt ({} tokens) + max_new_tokens ({}) exceeds "
+                "inference.max_len={}".format(prompt.size, max_new_tokens,
+                                              self.config.max_len))
+        if eos_token_id is None:
+            eos_token_id = self.config.eos_token_id
+        return self._scheduler.submit(
+            prompt, int(max_new_tokens), float(temperature),
+            int(top_k or 0), -1 if eos_token_id is None else int(eos_token_id),
+            int(seed))
+
+    # -------------------------------------------------------------- admit
+
+    def _admit(self, req, slot):
+        bucket = self.config.bucket_for(req.prompt.size)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :req.prompt.size] = req.prompt
+        self.timers("inference/prefill").start()
+        self._pool, first = self._prefill(
+            self._params, self._gcfg, self._pool, jnp.asarray(padded),
+            jnp.int32(req.prompt.size), jnp.int32(slot),
+            jnp.int32(req.max_new_tokens), jnp.int32(req.eos_token_id),
+            jnp.float32(req.temperature), jnp.int32(req.top_k),
+            jnp.uint32(req.seed))
+        self.timers("inference/prefill").stop()
+        self.counters["prefills"] += 1
+        first = int(first)
+        req.tokens.append(first)
+        req.first_token_time = time.time()
+        self.counters["tokens_out"] += 1
+        if req.max_new_tokens <= 1 or \
+                (req.eos_token_id >= 0 and first == req.eos_token_id):
+            self._scheduler.complete(slot)
+            self.counters["requests_completed"] += 1
+
+    # --------------------------------------------------------------- step
+
+    def step(self):
+        """One chunk boundary: admit into free slots, decode one chunk,
+        harvest tokens, evict finished slots. Returns the requests
+        completed during this step."""
+        done = []
+        for req, slot in self._scheduler.admissions():
+            self._admit(req, slot)
+            if req.done:
+                done.append(req)
+
+        if self._scheduler.running:
+            self.timers("inference/decode").start()
+            self._pool, toks, valid = self._decode(
+                self._params, self._gcfg, self.config.chunk_size, self._pool)
+            self.timers("inference/decode").stop()
+            toks = np.asarray(toks)
+            valid = np.asarray(valid)
+            active = np.asarray(self._pool["active"])
+            self.counters["chunks"] += 1
+            self.counters["occupied_slot_steps"] += int(valid.sum())
+            self.counters["slot_steps"] += valid.size
+            for slot, req in list(self._scheduler.running.items()):
+                emitted = toks[valid[:, slot], slot].tolist()
+                req.tokens.extend(emitted)
+                self.counters["tokens_out"] += len(emitted)
+                if not active[slot]:
+                    self._scheduler.complete(slot)
+                    self.counters["requests_completed"] += 1
+                    done.append(req)
+        return done
+
+    def run(self, max_steps=None):
+        """Drive step() until queue and slots drain; returns completed
+        requests in completion order."""
+        out = []
+        steps = 0
+        while not self._scheduler.idle:
+            out.extend(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                logger.warning("inference.run: stopping after %d steps with "
+                               "%d requests still in flight", steps,
+                               len(self._scheduler.running) +
+                               len(self._scheduler.queue))
+                break
+        return out
+
+    def generate(self, prompts, **kw):
+        """Batch convenience: submit every prompt, run to completion,
+        return token lists in submission order."""
+        reqs = [self.submit(p, **kw) for p in prompts]
+        self.run()
+        return [r.tokens for r in reqs]
+
+    # ------------------------------------------------------------ metrics
+
+    @property
+    def compile_count(self):
+        """Total compiled program count across prefill + decode — the
+        number the zero-recompile-after-warmup guarantee is asserted on."""
+        return self._prefill._cache_size() + self._decode._cache_size()
+
+    def metrics(self):
+        wall = max(time.time() - self._t0, 1e-9)
+        c = self.counters
+        return {
+            "tokens_out": c["tokens_out"],
+            "requests_completed": c["requests_completed"],
+            "prefills": c["prefills"],
+            "chunks": c["chunks"],
+            "tokens_per_sec": c["tokens_out"] / wall,
+            "slot_occupancy": (c["occupied_slot_steps"] /
+                               max(c["slot_steps"], 1)),
+            "queue_depth": len(self._scheduler.queue),
+            "running": len(self._scheduler.running),
+            "compile_count": self.compile_count,
+            "prefill_seconds": self.timers(
+                "inference/prefill").elapsed(reset=False),
+            "decode_seconds": self.timers(
+                "inference/decode").elapsed(reset=False),
+        }

@@ -1,0 +1,96 @@
+"""Slotted KV-cache pool — fixed-shape state for continuous batching.
+
+vLLM's paged KV cache (Kwon et al., SOSP'23) exists to fight GPU memory
+fragmentation from dynamic allocation; under XLA there IS no dynamic
+allocation — the constraint is the opposite: every program shape must be
+static. So the TPU-native analogue is a SLOT pool: one pre-allocated
+``[layers, slots, heads, max_len, head_dim]`` k/v cache plus per-slot
+scalar state, where "admitting a request" writes a slot index and
+"evicting" clears a flag. Batch composition changes without reshaping,
+so the decode program never recompiles (Orca-style continuous batching,
+Yu et al., OSDI'22, under a static shape).
+
+Per-slot state vector (all ``[slots]``-shaped device arrays):
+
+- ``pos``        row frontier: the sequence position the next k/v write
+                 lands at (== current sequence length);
+- ``last_tok``   the token sitting at the frontier (decode input);
+- ``active``     slot is mid-generation; inactive slots keep running in
+                 the fused program but are frozen (pos pinned, emissions
+                 masked) — same trick as ``generate``'s EOS rows;
+- ``remaining``  new tokens this request may still emit;
+- ``eos``        per-request EOS id (-1: none);
+- ``temp``/``top_k``/``seed``  per-request sampling params, traced (a
+                 request mix never changes the program).
+
+Stale cache safety: an evicted slot's k/v are NOT cleared. Re-admission
+prefills positions ``0..Tp-1``, and decode writes position ``p`` before
+any query's causal mask (``k_pos <= q_pos``) can reach it — stale keys
+are always either overwritten or masked, never attended.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+# State fields beside the k/v planes, with init value dtype.
+_SLOT_FIELDS = (
+    ("pos", jnp.int32, 0),
+    ("last_tok", jnp.int32, 0),
+    ("active", jnp.bool_, False),
+    ("remaining", jnp.int32, 0),
+    ("eos", jnp.int32, -1),
+    ("temp", jnp.float32, 0.0),
+    ("top_k", jnp.int32, 0),
+    ("seed", jnp.uint32, 0),
+)
+
+
+def init_pool(gcfg, num_slots, max_len, dtype=None):
+    """Zeroed pool pytree for ``num_slots`` sequences of up to ``max_len``
+    positions under generation config ``gcfg`` (models.generation.as_gencfg)."""
+    dtype = dtype or gcfg.dtype
+    hd = gcfg.n_embd // gcfg.n_head
+    kv_shape = (gcfg.n_layer, num_slots, gcfg.n_head, max_len, hd)
+    pool = {"k": jnp.zeros(kv_shape, dtype), "v": jnp.zeros(kv_shape, dtype)}
+    for name, ft, fill in _SLOT_FIELDS:
+        pool[name] = jnp.full((num_slots,), fill, ft)
+    return pool
+
+
+def cache_view(pool):
+    """The pool's k/v/pos as a ``models.generation`` cache dict — the
+    decode step program consumes the pool's slots directly as batch rows."""
+    return {"k": pool["k"], "v": pool["v"], "pos": pool["pos"]}
+
+
+def kv_spec(mesh, n_head):
+    """PartitionSpec for a k/v plane [L, S, H, T, D]: heads over 'model'
+    when divisible (parallel/mesh.py owns the policy — it must stay
+    aligned with DEFAULT_TP_RULES' column-parallel qkv split)."""
+    return mesh_lib.kv_cache_spec(mesh, n_head)
+
+
+def pool_shardings(mesh, pool, n_head):
+    """NamedSharding pytree matching ``pool``: k/v head-sharded over
+    'model', per-slot state replicated. Used both to place the initial
+    pool and to pin jitted programs' out_shardings (without the pin,
+    GSPMD may silently replicate the cache on output and the memory
+    saving evaporates — same lesson as the pipeline engine's opt state)."""
+    kv = NamedSharding(mesh, kv_spec(mesh, n_head))
+    rep = NamedSharding(mesh, P())
+    return {name: (kv if name in ("k", "v") else rep) for name in pool}
+
+
+def shard_pool(mesh, pool, n_head):
+    sh = pool_shardings(mesh, pool, n_head)
+    return {name: jax.device_put(arr, sh[name]) for name, arr in pool.items()}
+
+
+def free_slots(pool):
+    """Host-side: indices of inactive slots (a device->host sync of one
+    bool vector — the only per-chunk transfer besides emitted tokens)."""
+    import numpy as np
+    return [int(i) for i in np.flatnonzero(~np.asarray(pool["active"]))]

@@ -1,0 +1,111 @@
+"""Continuous-batching scheduler — host-side request lifecycle.
+
+The device side (kv_pool / engine programs) is shape-static; ALL dynamic
+serving behavior lives here: a bounded FIFO queue, admission of queued
+requests into free slots at chunk boundaries, eviction of finished slots,
+and completion bookkeeping. Orca-style iteration-level scheduling
+(Yu et al., OSDI'22) degenerates to exactly this once the batch is a
+fixed slot set: the only decisions left are "which queued request takes
+which free slot" (FIFO) and "when" (every chunk boundary).
+
+Timestamps are stamped here (submit / first token / finish) so the
+serving benchmark and the engine's metrics read one source of truth.
+"""
+
+import collections
+import itertools
+import time
+
+
+class QueueFull(RuntimeError):
+    """Raised by submit() when the pending queue is at max_queue — the
+    backpressure signal for upstream callers (shed load or retry)."""
+
+
+class Request(object):
+    """One generation request and its accumulated output."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "temperature", "top_k",
+                 "eos_token_id", "seed", "tokens", "slot",
+                 "submit_time", "first_token_time", "finish_time")
+
+    def __init__(self, rid, prompt, max_new_tokens, temperature, top_k,
+                 eos_token_id, seed):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_token_id = eos_token_id
+        self.seed = seed
+        self.tokens = []
+        self.slot = None
+        self.submit_time = time.time()
+        self.first_token_time = None
+        self.finish_time = None
+
+    @property
+    def done(self):
+        return self.finish_time is not None
+
+
+class Scheduler(object):
+    """FIFO admission over a fixed slot set."""
+
+    def __init__(self, num_slots, max_queue):
+        self.num_slots = num_slots
+        self.max_queue = max_queue
+        self.queue = collections.deque()
+        self.running = {}           # slot -> Request
+        self.completed = {}         # rid -> Request
+        self._ids = itertools.count()
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, prompt, max_new_tokens, temperature, top_k,
+               eos_token_id, seed):
+        if len(self.queue) >= self.max_queue:
+            raise QueueFull(
+                "inference queue is full ({} pending); retry later or "
+                "raise inference.max_queue".format(len(self.queue)))
+        req = Request(next(self._ids), prompt, max_new_tokens, temperature,
+                      top_k, eos_token_id, seed)
+        self.queue.append(req)
+        return req
+
+    # --------------------------------------------------------- admission
+
+    def free_slot_ids(self):
+        return [s for s in range(self.num_slots) if s not in self.running]
+
+    def admissions(self):
+        """FIFO: pop (request, slot) pairs for every free slot while the
+        queue lasts. Called by the engine ONLY at chunk boundaries — the
+        decode program never sees a mid-chunk batch change."""
+        pairs = []
+        for slot in self.free_slot_ids():
+            if not self.queue:
+                break
+            req = self.queue.popleft()
+            req.slot = slot
+            self.running[slot] = req
+            pairs.append((req, slot))
+        return pairs
+
+    # -------------------------------------------------------- completion
+
+    def complete(self, slot):
+        """Evict ``slot``: its request is finished, the slot is free for
+        the next admission round."""
+        req = self.running.pop(slot)
+        req.finish_time = time.time()
+        req.slot = None
+        self.completed[req.rid] = req
+        return req
+
+    @property
+    def idle(self):
+        return not self.queue and not self.running
+
+    def occupancy(self):
+        return len(self.running) / float(self.num_slots)

@@ -35,13 +35,25 @@ _GenCfg = collections.namedtuple(
     "n_layer n_head n_embd n_positions dtype layer_norm_epsilon")
 
 
+def as_gencfg(cfg):
+    """Hashable ``_GenCfg`` view of a GPT2Config (or anything with the same
+    attrs) — the static-arg form every jitted decode program keys on."""
+    if isinstance(cfg, _GenCfg):
+        return cfg
+    return _GenCfg(cfg.n_layer, cfg.n_head, cfg.n_embd, cfg.n_positions,
+                   cfg.dtype, getattr(cfg, "layer_norm_epsilon", 1e-5))
+
+
 def init_cache(cfg, batch, max_len, dtype=None):
-    """Zeroed [layers, B, heads, max_len, head_dim] k/v cache + position."""
+    """Zeroed [layers, B, heads, max_len, head_dim] k/v cache + a PER-ROW
+    position frontier ``pos`` [B] (each row may sit at a different sequence
+    length — the slot semantics the serving engine needs; ``generate``
+    simply advances all rows in lockstep)."""
     dtype = dtype or cfg.dtype
     hd = cfg.n_embd // cfg.n_head
     shape = (cfg.n_layer, batch, cfg.n_head, max_len, hd)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
-            "pos": jnp.zeros((), jnp.int32)}
+            "pos": jnp.zeros((batch,), jnp.int32)}
 
 
 def _ln(x, p, eps):
@@ -58,30 +70,39 @@ def _dense(x, p):
 
 
 def _forward(params, cfg, ids, cache, last_only=False):
-    """ids [B, S] starting at cache['pos']; returns (logits [B, S, V] fp32,
-    updated cache). S=prompt_len for prefill, S=1 inside the decode scan.
+    """ids [B, S], row b starting at cache['pos'][b]; returns
+    (logits [B, S, V] fp32, updated cache). S=prompt_len for prefill, S=1
+    inside the decode scan. Positions are PER ROW: each row embeds, masks
+    and writes its k/v against its own frontier, so rows at different
+    sequence lengths (the serving engine's slots) share one program.
     ``last_only`` evaluates the LM head on the final position only (the
     prefill path — sampling reads just that row, and a [B, Tp, vocab]
     fp32 buffer would otherwise dominate prefill memory)."""
     B, S = ids.shape
     nh, hd = cfg.n_head, cfg.n_embd // cfg.n_head
-    pos0 = cache["pos"]
+    pos = cache["pos"]                                 # [B] row frontiers
     max_len = cache["k"].shape[3]
 
     eps = cfg.layer_norm_epsilon
     wte = params["wte"].astype(cfg.dtype)
-    pe = jax.lax.dynamic_slice_in_dim(
-        params["wpe"].astype(cfg.dtype), pos0, S, axis=0)
-    x = wte[ids] + pe[None]
+    q_pos = pos[:, None] + jnp.arange(S)[None]         # [B, S]
+    pe = params["wpe"].astype(cfg.dtype)[q_pos]        # [B, S, C] gather
+    x = wte[ids] + pe
 
-    q_pos = pos0 + jnp.arange(S)                       # [S]
     k_pos = jnp.arange(max_len)                        # [max_len]
-    # Causal vs the GLOBAL position: key j visible to query i iff j <= i.
-    # Cache slots past the current frontier are excluded by the same
-    # comparison (they hold zeros and positions > q_pos).
-    mask = k_pos[None, :] <= q_pos[:, None]            # [S, max_len]
+    # Causal vs each row's GLOBAL position: key j visible to query i iff
+    # j <= i. Cache slots past a row's frontier are excluded by the same
+    # comparison (they hold zeros — or a stale request's k/v, which decode
+    # overwrites before the frontier ever reaches them).
+    mask = k_pos[None, None, :] <= q_pos[:, :, None]   # [B, S, max_len]
     neg = jnp.finfo(jnp.float32).min
     k_cache, v_cache = cache["k"], cache["v"]
+
+    def write_rows(cache_l, new):
+        # [B, H, T, D] cache plane <- [B, H, S, D] at each row's frontier
+        # (vmapped dynamic_update_slice lowers to one scatter).
+        return jax.vmap(lambda c, n, p: jax.lax.dynamic_update_slice(
+            c, n, (0, p, 0)))(cache_l, new, pos)
 
     for i in range(cfg.n_layer):
         blk = params["h_{}".format(i)]
@@ -91,13 +112,11 @@ def _forward(params, cfg, ids, cache, last_only=False):
         q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k[None], (i, 0, 0, pos0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v[None], (i, 0, 0, pos0, 0))
+        k_cache = k_cache.at[i].set(write_rows(k_cache[i], k))
+        v_cache = v_cache.at[i].set(write_rows(v_cache[i], v))
         att = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache[i]).astype(
             jnp.float32) / jnp.sqrt(hd)
-        att = jnp.where(mask[None, None], att, neg)
+        att = jnp.where(mask[:, None], att, neg)
         att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
         y = jnp.einsum("bhqk,bhkd->bhqd", att, v_cache[i])
         y = y.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_embd)
@@ -112,7 +131,18 @@ def _forward(params, cfg, ids, cache, last_only=False):
     x = _ln(x, params["ln_f"], eps)
     logits = jnp.einsum("bsc,vc->bsv", x.astype(jnp.float32),
                         params["wte"].astype(jnp.float32))
-    return logits, {"k": k_cache, "v": v_cache, "pos": pos0 + S}
+    return logits, {"k": k_cache, "v": v_cache, "pos": pos + S}
+
+
+def decode_step(params, cfg, tok, cache):
+    """Advance every row one token: feed ``tok`` [B] (the token sitting at
+    each row's frontier ``cache['pos']``), write its k/v there, and return
+    (fp32 logits [B, V] for the next position, advanced cache). THE decode
+    step program — ``generate``'s scan body and the serving engine's
+    chunked decode (deepspeed_tpu.inference) both drive it, which is what
+    keeps single-shot and continuous-batching outputs token-identical."""
+    logits, cache = _forward(params, cfg, tok[:, None], cache)
+    return logits[:, 0], cache
 
 
 def _sample(logits, rng, temperature, top_k):
@@ -139,8 +169,8 @@ def _generate_jit(params, cfg, prompt_ids, max_new_tokens, temperature,
 
     def step(carry, rng_t):
         tok, cache, done = carry
-        logits, cache = _forward(params, cfg, tok[:, None], cache)
-        nxt = _sample(logits[:, 0], rng_t, temperature, top_k)
+        logits, cache = decode_step(params, cfg, tok, cache)
+        nxt = _sample(logits, rng_t, temperature, top_k)
         if done is not None:
             done = done | (tok == eos_token_id)
             nxt = jnp.where(done, eos_token_id, nxt)
@@ -162,9 +192,7 @@ def generate(model, params, prompt_ids, max_new_tokens, temperature=1.0,
     Returns [B, max_new_tokens] int32. Rows that emit ``eos_token_id``
     keep repeating it (fixed-length output; trim host-side).
     """
-    cfg = getattr(model, "config", model)
-    cfg = _GenCfg(cfg.n_layer, cfg.n_head, cfg.n_embd, cfg.n_positions,
-                  cfg.dtype, getattr(cfg, "layer_norm_epsilon", 1e-5))
+    cfg = as_gencfg(getattr(model, "config", model))
     assert max_new_tokens >= 1
     if rng is None:
         rng = jax.random.PRNGKey(0)

@@ -234,6 +234,21 @@ def zero_shardings(mesh: Mesh, params, stage: int, tp_rules=None):
     return param_sh, grad_sh, opt_state_sharding
 
 
+def kv_cache_spec(mesh: Mesh, n_head: int, heads_dim: int = 2):
+    """PartitionSpec for a slotted KV-cache plane [layers, slots, heads,
+    max_len, head_dim]: heads over 'model' when divisible. Aligned with
+    DEFAULT_TP_RULES' column-parallel qkv split — a tensor-sharded model's
+    decode writes/reads only its local heads, and GSPMD inserts the same
+    output-projection all-reduce as training. Indivisible head counts
+    replicate (correct, just without the memory saving)."""
+    mp = mp_size(mesh)
+    if mp > 1 and n_head % mp == 0:
+        spec = [None, None, None, None, None]
+        spec[heads_dim] = MODEL_AXIS
+        return P(*spec)
+    return P()
+
+
 def active_sp_axis(axis_name):
     """``axis_name`` IF the caller is being traced inside a shard_map that
     binds it; None otherwise. Lets a model switch to its sequence-parallel

@@ -382,6 +382,7 @@ class DeepSpeedConfig(object):
         self.sparse_attention = parse_sparse_attention(param_dict)
         self.pipeline = dict(_PIPELINE_DEFAULTS,
                              **param_dict.get("pipeline", {}))
+        self.inference = self._parse_inference(param_dict)
 
         tag_mode = str(_read(param_dict, (CHECKPOINT,
                                           CHECKPOINT_TAG_VALIDATION),
@@ -394,6 +395,26 @@ class DeepSpeedConfig(object):
         self.checkpoint_tag_validation_enabled = \
             tag_mode != ValidationMode.IGNORE
         self.checkpoint_tag_validation_fail = tag_mode == ValidationMode.FAIL
+
+    @staticmethod
+    def _parse_inference(param_dict):
+        """``inference`` block -> defaults-merged dict (TPU delta: the
+        reference has no inference engine at all in v0.3.10). Keys are
+        validated here so a ds_config typo fails at parse time, not at
+        init_inference time; the dict feeds InferenceConfig.from_dict."""
+        from deepspeed_tpu.inference.config import INFERENCE_DEFAULTS
+
+        block = param_dict.get("inference", {})
+        if not isinstance(block, dict):
+            raise TypeError(
+                "DeepSpeedConfig: expected 'inference' to be a JSON "
+                "object, got {!r}".format(block))
+        unknown = set(block) - set(INFERENCE_DEFAULTS)
+        if unknown:
+            raise ValueError(
+                "DeepSpeedConfig: unknown inference key(s) {}; valid keys: "
+                "{}".format(sorted(unknown), sorted(INFERENCE_DEFAULTS)))
+        return dict(INFERENCE_DEFAULTS, **block)
 
     def _batch_assertion(self):
         train_batch = self.train_batch_size

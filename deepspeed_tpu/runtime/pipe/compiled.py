@@ -54,6 +54,14 @@ from deepspeed_tpu.runtime.utils import ensure_directory_exists
 from deepspeed_tpu.utils.logging import log_dist
 
 
+# Disjoint fold domains for the prologue / epilogue per-micro-batch
+# dropout streams: the pipelined stages fold (tick t, stage s) directly
+# off ``rng``, so the micro-batch folds must branch off a distinct
+# subtree or micro-batch m would collide with tick t == m.
+_PRO_FOLD = 0x5f0a0b01
+_EPI_FOLD = 0x5f0a0b02
+
+
 def _spec_key(spec):
     return (spec.typename, tuple(spec.module_args),
             tuple(sorted(spec.module_kwargs.items())))
@@ -121,6 +129,15 @@ class CompiledPipelineEngine(PipelineEngine):
                 "compiled pipeline v1 does not implement fp16 dynamic "
                 "loss scaling (overflow-skip needs host control flow); "
                 "use bf16 or the interpreter engine (compiled=False)")
+        from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdam
+        if isinstance(self.optimizer, OnebitAdam):
+            raise ValueError(
+                "compiled pipeline v1 does not support OnebitAdam: its "
+                "flat error-feedback buffers don't carry the [stage, "
+                "block] stacking axis, so the engine would silently shard "
+                "them over the pipe axis on their first (per-worker) dim; "
+                "use the interpreter engine (compiled=False) or a dense "
+                "optimizer")
         if self.zero_optimization() and self.zero_optimization_stage() >= 2:
             raise ValueError(
                 "compiled pipeline v1 composes PP with ZeRO stage 1 "
@@ -298,7 +315,12 @@ class CompiledPipelineEngine(PipelineEngine):
                                 rngs=rngs_of(jax.random.fold_in(rng, l)))
             return h
 
-        from jax import shard_map
+        try:
+            from jax import shard_map
+            _rep_kw = {"check_vma": False}
+        except ImportError:  # older jax keeps it under experimental
+            from jax.experimental.shard_map import shard_map
+            _rep_kw = {"check_rep": False}
 
         axis_p, axis_d = mesh_lib.PIPE_AXIS, mesh_lib.DATA_AXIS
         # No wraparound edge: stage 0 always takes the fresh micro-batch,
@@ -348,11 +370,18 @@ class CompiledPipelineEngine(PipelineEngine):
                 jax.checkpoint(tick), (slab0, out0),
                 jnp.arange(M + S - 1))
 
-            def epi(hm, ym):
+            def epi(hm, ym, m):
+                # Per-micro-batch dropout stream (fold the micro index, on
+                # a domain disjoint from the tick/stage folds) — one
+                # shared rng across the vmap would correlate every
+                # micro-batch's masks, unlike the interpreter engine's
+                # per-micro-batch rngs.
+                erng = jax.random.fold_in(jax.random.fold_in(rng, _EPI_FOLD),
+                                          m)
                 for layer, p in zip(epi_layers, epi_params):
                     if _is_flax_module(layer):
                         hm = layer.apply({"params": p}, hm,
-                                         rngs=rngs_of(rng))
+                                         rngs=rngs_of(erng))
                     else:
                         hm = layer(hm)
                 if loss_fn is not None:
@@ -362,19 +391,24 @@ class CompiledPipelineEngine(PipelineEngine):
             # Non-last shards ran the epilogue on zeros; only the last
             # stage's loss counts (summed over the one live shard), then
             # batch-averaged over the data axis.
-            losses = jax.vmap(epi)(outputs, ys)
+            losses = jax.vmap(epi)(outputs, ys, jnp.arange(M))
             local = jnp.where(sidx == S - 1, jnp.mean(losses), 0.0)
             return jax.lax.pmean(jax.lax.psum(local, axis_p), axis_d)
 
         def loss_of(params, xs, ys, rng):
             params = cast(params)
             # xs: [M, mb, ...] micro-batches; prologue is data-parallel.
+            # Dropout rng folds the micro-batch index (interpreter
+            # engines draw a fresh rng per micro-batch forward; a shared
+            # key across the vmap would reuse one mask M times).
             h = xs
             for layer, p in zip(pro_layers, params["prologue"]):
                 if _is_flax_module(layer):
-                    h = jax.vmap(lambda hm, _l=layer, _p=p: _l.apply(
+                    h = jax.vmap(lambda hm, m, _l=layer, _p=p: _l.apply(
                         {"params": _p}, hm,
-                        rngs=rngs_of(rng)))(h)
+                        rngs=rngs_of(jax.random.fold_in(
+                            jax.random.fold_in(rng, _PRO_FOLD), m))))(
+                                h, jnp.arange(M))
                 else:
                     h = jax.vmap(layer)(h)
             h = csp(h, P(None, "data"))
@@ -383,8 +417,8 @@ class CompiledPipelineEngine(PipelineEngine):
                 in_specs=(P(axis_p), P(), P(None, axis_d),
                           P(None, axis_d), P()),
                 out_specs=P(),
-                check_vma=False)(params["blocks"], params["epilogue"],
-                                 h, ys, rng)
+                **_rep_kw)(params["blocks"], params["epilogue"],
+                           h, ys, rng)
 
         return loss_of
 
@@ -597,9 +631,6 @@ class CompiledPipelineEngine(PipelineEngine):
             with open(latest) as fd:
                 tag = fd.read().strip()
         ckpt_dir = os.path.join(load_dir, str(tag))
-        assert self._materialized, \
-            "run one train_batch before loading a compiled-pipeline " \
-            "checkpoint so layer shapes exist"
         tm = jax.tree_util.tree_map
 
         def load_layer(idx):
@@ -611,6 +642,23 @@ class CompiledPipelineEngine(PipelineEngine):
 
         per_layer = [load_layer(i)
                      for i in range(len(self.pipe_module.layer_specs))]
+        if not self._materialized:
+            # Canonical initialize -> load_checkpoint -> train flow: the
+            # checkpointed arrays carry every shape a probe forward would
+            # have produced, so materialize straight from them (no
+            # train_batch needed first). Only the pipelined run's block
+            # layers are required — they are all parameterized by
+            # construction, so a missing file is a broken checkpoint.
+            i0, i1 = self._run
+            missing = [i for i in range(i0, i1) if per_layer[i] is None]
+            if missing:
+                raise ValueError(
+                    "cannot materialize from checkpoint {}: missing "
+                    "layer file(s) for pipelined block layer(s) {} "
+                    "(expected {})".format(
+                        ckpt_dir, missing,
+                        self.pipe_module.ckpt_layer_path(ckpt_dir,
+                                                         missing[0])))
         restacked = self._cp_restack_tree(per_layer)
         rep = self._cp_sharding(P())
         self._cp_params = {
@@ -621,6 +669,7 @@ class CompiledPipelineEngine(PipelineEngine):
         }
         opt_path = os.path.join(
             ckpt_dir, "zero_pp_rank_0_mp_rank_00optim_states.pt")
+        loaded_opt = False
         if kwargs.get("load_optimizer_states", True) and \
                 os.path.exists(opt_path):
             with open(opt_path, "rb") as f:
@@ -628,4 +677,12 @@ class CompiledPipelineEngine(PipelineEngine):
             if isinstance(saved, list) and any(s is not None
                                                for s in saved):
                 self._cp_opt_state = self._cp_restack_opt_states(saved)
+                loaded_opt = True
+        if not self._materialized:
+            if not loaded_opt and self.optimizer is not None:
+                # Checkpoint carried no optimizer states (or the caller
+                # skipped them): fresh moments over the loaded params.
+                self._cp_opt_state = self._cp_place_state(
+                    self.optimizer.init_state(self._cp_params))
+            self._materialized = True
         return ckpt_dir, self._load_ckpt_meta(ckpt_dir)

@@ -300,3 +300,20 @@ def test_committed_last_good_artifact_is_valid():
         assert entry["metric"] == metric
         assert entry["extra"]["platform"] == "tpu"
         assert "vs_baseline" in entry
+
+
+def test_serving_smoke_measures_in_process(bench):
+    """`bench.py --serve-smoke` must run end-to-end on the virtual CPU
+    backend and report a well-formed serving line: positive throughput,
+    latency percentiles, and ZERO recompiles after warmup (the engine's
+    compile-count contract, measured in the benchmark itself)."""
+    r = bench._measure_serving(smoke=True)
+    assert r["metric"] == "gpt2_tiny_smoke_serving_tokens_per_sec"
+    assert r["value"] > 0 and r["unit"] == "tokens/s"
+    assert r["vs_baseline"] > 0
+    e = r["extra"]
+    assert e["tokens_out"] == e["requests"] * e["max_new_tokens"]
+    assert e["recompiles_after_warmup"] == 0
+    assert 0.0 < e["slot_occupancy"] <= 1.0
+    assert e["p50_per_token_latency_ms"] <= e["p99_per_token_latency_ms"]
+    json.dumps(r)  # driver-facing line must be JSON-serializable

@@ -285,3 +285,52 @@ def test_compiled_eval_batch_deterministic_and_matches_interpreter(
     interp.load_checkpoint(str(tmp_path / "ck"))
     ei = interp.eval_batch(iter(list(micro)))
     np.testing.assert_allclose(e1, ei, rtol=2e-4, atol=1e-5)
+
+
+def test_compiled_load_checkpoint_before_first_batch(eight_devices, tmp_path):
+    """load_checkpoint on a FRESH engine must materialize params and
+    moments from the checkpoint files — resuming a run cannot require a
+    throwaway train_batch just to allocate state (the warm engine and the
+    cold-resumed engine must stay in lockstep afterwards)."""
+    data = batches(4, 2)
+    warm = make_engine(True)
+    for step in range(2):
+        warm.train_batch(data_iter=iter(list(data[step])))
+    warm.save_checkpoint(str(tmp_path / "ck"))
+
+    cold = make_engine(True)
+    cold.load_checkpoint(str(tmp_path / "ck"))  # no prior train_batch
+    assert cold.global_steps == 2
+    for step in (2, 3):
+        lw = warm.train_batch(data_iter=iter(list(data[step])))
+        lc = cold.train_batch(data_iter=iter(list(data[step])))
+        np.testing.assert_allclose(lc, lw, rtol=2e-4, atol=1e-5)
+
+
+def test_compiled_load_checkpoint_missing_files_raises(eight_devices,
+                                                       tmp_path):
+    """A cold engine pointed at a directory without its layer files must
+    fail loudly (listing what is missing), not materialize garbage."""
+    cold = make_engine(True)
+    (tmp_path / "ck" / "global_step0").mkdir(parents=True)
+    (tmp_path / "ck" / "latest").write_text("global_step0")
+    with pytest.raises(ValueError, match="layer"):
+        cold.load_checkpoint(str(tmp_path / "ck"))
+
+
+def test_compiled_rejects_onebit_adam(eight_devices):
+    """OnebitAdam's flat error-feedback buffers don't carry the compiled
+    engine's [stage, block] stacking axis — constructing the pair must
+    raise at init, not corrupt state at step time."""
+    layers = [LayerSpec(DenseRelu, 32) for _ in range(8)] + \
+        [LayerSpec(DenseOut, 8)]
+    model = PipelineModule(layers=layers, num_stages=4, loss_fn=ce_loss,
+                           seed_layers=True, base_seed=42,
+                           partition_method="uniform", compiled=True)
+    with pytest.raises(ValueError, match="OnebitAdam"):
+        deepspeed.initialize(model=model, config_params={
+            "train_batch_size": 16,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "OneBitAdam",
+                          "params": {"lr": 1e-2, "freeze_step": 2}},
+        })
