@@ -676,7 +676,41 @@ def _measure_bert(sparse, steps):
     })
 
 
-def _measure_serving(smoke=False):
+def _decode_attention_probe(engine, reps=10):
+    """Jitted micro-timing of ONE layer's decode-attention op at the
+    engine's decode shape (worst-case frontier: every block active), on
+    whichever path the engine engaged — flash kernel or dense einsum. The
+    serving metric can't isolate the attention op from the rest of the
+    decode step; this number makes the kernel A/B attributable in the
+    bench artifact. Returns (ms_per_call, engaged_flash)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.transformer.kernels import decode_attention as da
+
+    g = engine._gcfg
+    b = engine.config.max_slots
+    h, d = g.n_head, g.n_embd // g.n_head
+    t = engine._pool["k"].shape[3]
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, 1, d), g.dtype)
+    k = jnp.asarray(rng.randn(b, h, t, d), g.dtype)
+    v = jnp.asarray(rng.randn(b, h, t, d), g.dtype)
+    pos = jnp.full((b,), t - 1, jnp.int32)
+    use_flash = bool(g.use_flash_decode) and da.decode_supported(t)
+    fn = da.flash_decode_attention if use_flash \
+        else da.decode_attention_reference
+    jitted = jax.jit(fn)
+    jax.block_until_ready(jitted(q, k, v, pos))   # compile + warmup
+    t0 = time.time()
+    out = None
+    for _ in range(reps):
+        out = jitted(q, k, v, pos)
+    jax.block_until_ready(out)
+    return (time.time() - t0) / reps * 1e3, use_flash
+
+
+def _measure_serving(smoke=False, flash_decode=None):
     """Continuous-batching serving benchmark (deepspeed_tpu/inference/).
 
     A synthetic Poisson request stream plays against the slotted engine:
@@ -686,12 +720,16 @@ def _measure_serving(smoke=False):
     occupancy; ``vs_baseline`` is the throughput ratio against serving
     the SAME requests one at a time through models.generation.generate —
     the continuous-batching win itself. ``smoke`` runs the tiny model
-    with a short stream (the tier-1 in-process mode)."""
+    with a short stream (the tier-1 in-process mode). ``flash_decode``
+    forces the decode-attention path (None: the engine's default — the
+    Pallas kernel on TPU); ``--no-flash-decode`` sets False for the
+    einsum side of the kernel A/B."""
     import jax
 
     import deepspeed_tpu as deepspeed
     from deepspeed_tpu.models.generation import generate
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.ops.transformer.kernels import decode_attention as da
 
     platform = jax.default_backend()
     on_tpu = platform == "tpu" and not smoke
@@ -709,6 +747,8 @@ def _measure_serving(smoke=False):
         serve_cfg = {"max_slots": 4, "max_len": 64, "chunk_size": 4,
                      "prefill_buckets": (16,), "max_queue": n_req}
         prompt_lens, max_new = (4, 12), 8
+    if flash_decode is not None:
+        serve_cfg["use_flash_decode"] = flash_decode
 
     model = GPT2LMHeadModel(cfg)
     rng = np.random.RandomState(0)
@@ -732,6 +772,10 @@ def _measure_serving(smoke=False):
     engine.generate([prompts[lens.index(n)] for n in sorted(set(lens))],
                     max_new_tokens=2)
     warm_compiles = engine.compile_count
+    # Post-warmup decode-timer snapshot: the per-token decode number must
+    # exclude the warmup chunks' compile time.
+    warm_decode_s = engine.timers("inference/decode").elapsed(reset=False)
+    warm_chunks = engine.counters["chunks"]
 
     t0 = time.time()
     submitted, reqs, done = 0, [], []
@@ -767,9 +811,25 @@ def _measure_serving(smoke=False):
     seq_tok_per_sec = toks_out / seq_wall
     tok_per_sec = toks_out / wall
 
+    # Kernel A/B attribution: which decode-attention path served, its
+    # planned tile, and the isolated per-step op time.
+    g = engine._gcfg
+    plane_len = int(engine._pool["k"].shape[3])
+    attn_ms, engaged = _decode_attention_probe(engine)
+    block_k = da.planned_block_k(
+        serve_cfg["max_slots"], g.n_head, 1, plane_len,
+        g.n_embd // g.n_head, g.dtype) if engaged else None
+    decode_steps = (m["chunks"] - warm_chunks) * serve_cfg["chunk_size"]
+    decode_s = m["decode_seconds"] - warm_decode_s
+
+    name = "gpt2_{}_serving_tokens_per_sec".format(
+        "355m" if on_tpu else "tiny_smoke" if smoke else "tiny")
+    if flash_decode is False:
+        # A/B runs must not share last-good bookkeeping with the default
+        # (kernel-on) metric series.
+        name += "_noflashdecode"
     return {
-        "metric": "gpt2_{}_serving_tokens_per_sec".format(
-            "355m" if on_tpu else "tiny_smoke" if smoke else "tiny"),
+        "metric": name,
         "value": round(tok_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tok_per_sec / seq_tok_per_sec, 4),
@@ -791,14 +851,21 @@ def _measure_serving(smoke=False):
             "recompiles_after_warmup": m["compile_count"] - warm_compiles,
             "max_slots": serve_cfg["max_slots"],
             "chunk_size": serve_cfg["chunk_size"],
+            "flash_decode": engaged,
+            "decode_block_k": block_k,
+            "kv_plane_len": plane_len,
+            "decode_attention_ms_per_layer": round(attn_ms, 4),
+            "decode_attention_ms_per_step": round(attn_ms * g.n_layer, 4),
+            "decode_ms_per_token": round(
+                decode_s / max(decode_steps, 1) * 1e3, 4),
         },
     }
 
 
-def main_serve(smoke=False):
+def main_serve(smoke=False, flash_decode=None):
     if not smoke:
         _require_tpu_or_exit()
-    _emit(_measure_serving(smoke=smoke))
+    _emit(_measure_serving(smoke=smoke, flash_decode=flash_decode))
     return 0
 
 
@@ -836,10 +903,13 @@ def main_sweep():
 
 
 def _dispatch(argv):
+    # --no-flash-decode: the einsum side of the decode-kernel A/B
+    # (default None lets the engine pick — the Pallas kernel on TPU).
+    flash_decode = False if "--no-flash-decode" in argv else None
     if "--serve-smoke" in argv:
-        return main_serve(smoke=True)
+        return main_serve(smoke=True, flash_decode=flash_decode)
     if "--serve" in argv:
-        return main_serve()
+        return main_serve(flash_decode=flash_decode)
     if "--sweep" in argv:
         return main_sweep()
     if "--xl-compute" in argv:

@@ -24,6 +24,7 @@ INFERENCE_DEFAULTS = {
     "max_queue": 64,
     "eos_token_id": None,
     "max_new_tokens": 128,
+    "use_flash_decode": None,
 }
 
 
@@ -63,6 +64,14 @@ class InferenceConfig:
     eos_token_id: Optional[int] = None
     # Default per-request new-token budget.
     max_new_tokens: int = 128
+    # Decode-attention kernel selection: True forces the Pallas
+    # flash-decode kernel (ops/transformer/kernels/decode_attention.py),
+    # False forces the dense einsum path, None defers to the model config
+    # and then generation.default_flash_decode() (on by default on TPU).
+    # When the kernel is on, the KV pool pads max_len up to the kernel's
+    # 128-position block quantum (admission limits still enforce the
+    # configured max_len).
+    use_flash_decode: Optional[bool] = None
 
     def __post_init__(self):
         if self.max_slots < 1:

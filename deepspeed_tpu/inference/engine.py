@@ -39,6 +39,7 @@ from deepspeed_tpu.inference.config import InferenceConfig
 from deepspeed_tpu.inference.kv_pool import (
     cache_view,
     init_pool,
+    max_active_frontier,
     pool_shardings,
     shard_pool,
 )
@@ -160,7 +161,13 @@ class InferenceEngine(object):
         elif isinstance(config, dict):
             config = InferenceConfig.from_dict(config)
         self.config = config
-        self._gcfg = generation.as_gencfg(getattr(model, "config", model))
+        # The engine's flag wins over the model config's; None defers down
+        # the chain (model config, then on-TPU default). The resolved flag
+        # rides the gencfg static arg, so flash vs einsum is baked into
+        # both programs at trace time — no per-call dispatch.
+        self._gcfg = generation.as_gencfg(
+            getattr(model, "config", model),
+            use_flash_decode=config.use_flash_decode)
         config.validate_against_model(self._gcfg.n_positions)
         self.mesh = mesh
         self._scheduler = Scheduler(config.max_slots, config.max_queue)
@@ -336,4 +343,6 @@ class InferenceEngine(object):
                 "inference/prefill").elapsed(reset=False),
             "decode_seconds": self.timers(
                 "inference/decode").elapsed(reset=False),
+            "flash_decode": bool(self._gcfg.use_flash_decode),
+            "max_active_frontier": max_active_frontier(self._pool),
         }

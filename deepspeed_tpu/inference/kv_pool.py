@@ -27,12 +27,34 @@ Stale cache safety: an evicted slot's k/v are NOT cleared. Re-admission
 prefills positions ``0..Tp-1``, and decode writes position ``p`` before
 any query's causal mask (``k_pos <= q_pos``) can reach it — stale keys
 are always either overwritten or masked, never attended.
+
+Layout invariants the flash-decode kernel
+(ops/transformer/kernels/decode_attention.py) relies on:
+
+- plane layout is ``[layers, slots, heads, plane_len, head_dim]`` with
+  the LENGTH dim fourth — the kernel blocks along it, so it must be the
+  second-minor axis of each per-layer ``[slots, heads, len, hd]`` view;
+- when flash-decode serves the pool, ``plane_len`` is padded up to a
+  multiple of ``decode_attention.BLOCK_MIN`` (128) by ``init_pool``;
+  padding is inert because admission still enforces the CONFIGURED
+  ``max_len`` (``prompt + max_new_tokens <= max_len``), so no frontier
+  ever reaches a padded position and the mask excludes them all;
+- ``pos[b]`` is the PRE-write frontier: positions ``0..pos[b]-1`` hold
+  the row's valid k/v, everything at ``>= pos[b] + S`` (after a write of
+  S new positions) is zeros or a stale request's data. The kernel's
+  per-row visibility rule ``k_pos <= pos[b] + i`` (query row i) must
+  exactly match models/generation.py's einsum mask — parity tests pin
+  this — so stale positions are skipped, not merely down-weighted;
+- frontiers only move via the jitted programs (prefill sets, decode
+  advances by S); host code never writes ``pos`` directly, which is what
+  makes ``max_active_frontier`` a safe work-bound hint between chunks.
 """
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.ops.transformer.kernels import decode_attention
 from deepspeed_tpu.parallel import mesh as mesh_lib
 
 # State fields beside the k/v planes, with init value dtype.
@@ -48,16 +70,42 @@ _SLOT_FIELDS = (
 )
 
 
+def plane_len_for(gcfg, max_len):
+    """Cache-plane length serving ``max_len`` positions under ``gcfg``:
+    padded up to the flash-decode block quantum when the kernel serves
+    the pool (see module docstring — padding is inert), ``max_len``
+    as-is otherwise."""
+    if getattr(gcfg, "use_flash_decode", False):
+        return decode_attention.pad_cache_len(max_len)
+    return max_len
+
+
 def init_pool(gcfg, num_slots, max_len, dtype=None):
     """Zeroed pool pytree for ``num_slots`` sequences of up to ``max_len``
-    positions under generation config ``gcfg`` (models.generation.as_gencfg)."""
+    positions under generation config ``gcfg`` (models.generation.as_gencfg).
+    The allocated plane length is ``plane_len_for(gcfg, max_len)``."""
     dtype = dtype or gcfg.dtype
     hd = gcfg.n_embd // gcfg.n_head
-    kv_shape = (gcfg.n_layer, num_slots, gcfg.n_head, max_len, hd)
+    plane_len = plane_len_for(gcfg, max_len)
+    if getattr(gcfg, "use_flash_decode", False):
+        assert decode_attention.decode_supported(plane_len), plane_len
+    kv_shape = (gcfg.n_layer, num_slots, gcfg.n_head, plane_len, hd)
     pool = {"k": jnp.zeros(kv_shape, dtype), "v": jnp.zeros(kv_shape, dtype)}
     for name, ft, fill in _SLOT_FIELDS:
         pool[name] = jnp.full((num_slots,), fill, ft)
     return pool
+
+
+def max_active_frontier(pool):
+    """Host-side hint: the largest frontier among ACTIVE slots (one small
+    device->host sync). The kernel already bounds its own work PER ROW
+    from ``pool['pos']`` via scalar prefetch; this cross-row bound is the
+    observability companion — the serving benchmark stamps it, and a
+    future work-partitioned grid can cap its length extent with it."""
+    import numpy as np
+    pos = np.asarray(pool["pos"])
+    active = np.asarray(pool["active"])
+    return int((pos * active).max()) if pos.size else 0
 
 
 def cache_view(pool):

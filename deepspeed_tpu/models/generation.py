@@ -24,24 +24,50 @@ parity against the training forward). TPU-first mechanics:
 
 import collections
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer.kernels import decode_attention
 
 # Hashable shape/dtype subset of GPT2Config (the dataclass itself is
 # unhashable, and jit's static args must hash).
 _GenCfg = collections.namedtuple(
     "_GenCfg",
-    "n_layer n_head n_embd n_positions dtype layer_norm_epsilon")
+    "n_layer n_head n_embd n_positions dtype layer_norm_epsilon "
+    "use_flash_decode", defaults=(False,))
 
 
-def as_gencfg(cfg):
+def default_flash_decode():
+    """Policy for configs that don't say (``use_flash_decode=None``):
+    the DS_TPU_FLASH_DECODE env overrides; otherwise the Pallas decode
+    kernel engages on TPU only. Off-TPU it would run in interpret mode —
+    semantically identical but orders of magnitude slower, a test-only
+    path the parity suite opts into explicitly."""
+    env = os.environ.get("DS_TPU_FLASH_DECODE", "")
+    if env:
+        return env not in ("0", "false")
+    return jax.default_backend() == "tpu"
+
+
+def as_gencfg(cfg, use_flash_decode=None):
     """Hashable ``_GenCfg`` view of a GPT2Config (or anything with the same
-    attrs) — the static-arg form every jitted decode program keys on."""
+    attrs) — the static-arg form every jitted decode program keys on.
+    ``use_flash_decode`` overrides the config's own flag; None defers to
+    the config, then to ``default_flash_decode()``."""
     if isinstance(cfg, _GenCfg):
+        if use_flash_decode is not None:
+            return cfg._replace(use_flash_decode=bool(use_flash_decode))
         return cfg
+    flag = use_flash_decode
+    if flag is None:
+        flag = getattr(cfg, "use_flash_decode", None)
+    if flag is None:
+        flag = default_flash_decode()
     return _GenCfg(cfg.n_layer, cfg.n_head, cfg.n_embd, cfg.n_positions,
-                   cfg.dtype, getattr(cfg, "layer_norm_epsilon", 1e-5))
+                   cfg.dtype, getattr(cfg, "layer_norm_epsilon", 1e-5),
+                   bool(flag))
 
 
 def init_cache(cfg, batch, max_len, dtype=None):
@@ -89,13 +115,19 @@ def _forward(params, cfg, ids, cache, last_only=False):
     pe = params["wpe"].astype(cfg.dtype)[q_pos]        # [B, S, C] gather
     x = wte[ids] + pe
 
-    k_pos = jnp.arange(max_len)                        # [max_len]
-    # Causal vs each row's GLOBAL position: key j visible to query i iff
-    # j <= i. Cache slots past a row's frontier are excluded by the same
-    # comparison (they hold zeros — or a stale request's k/v, which decode
-    # overwrites before the frontier ever reaches them).
-    mask = k_pos[None, None, :] <= q_pos[:, :, None]   # [B, S, max_len]
-    neg = jnp.finfo(jnp.float32).min
+    # Flash-decode engages when the flag is on AND the cache plane length
+    # fits the kernel's block quantum (kv_pool pads its pool; ad-hoc
+    # caches of other lengths take the einsum path below — same math).
+    use_flash = cfg.use_flash_decode and \
+        decode_attention.decode_supported(max_len)
+    if not use_flash:
+        k_pos = jnp.arange(max_len)                    # [max_len]
+        # Causal vs each row's GLOBAL position: key j visible to query i
+        # iff j <= i. Cache slots past a row's frontier are excluded by
+        # the same comparison (they hold zeros — or a stale request's
+        # k/v, which decode overwrites before the frontier reaches them).
+        mask = k_pos[None, None, :] <= q_pos[:, :, None]  # [B, S, max_len]
+        neg = jnp.finfo(jnp.float32).min
     k_cache, v_cache = cache["k"], cache["v"]
 
     def write_rows(cache_l, new):
@@ -114,11 +146,20 @@ def _forward(params, cfg, ids, cache, last_only=False):
         v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         k_cache = k_cache.at[i].set(write_rows(k_cache[i], k))
         v_cache = v_cache.at[i].set(write_rows(v_cache[i], v))
-        att = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache[i]).astype(
-            jnp.float32) / jnp.sqrt(hd)
-        att = jnp.where(mask[:, None], att, neg)
-        att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
-        y = jnp.einsum("bhqk,bhkd->bhqd", att, v_cache[i])
+        if use_flash:
+            # Fused QK-score + online softmax + PV over the cache plane,
+            # frontier-aware: blocks past pos[b]+S-1 are skipped. The
+            # cache was just written, so pos is the PRE-write frontier
+            # the kernel's mask convention expects.
+            y = decode_attention.flash_decode_attention(
+                q, k_cache[i], v_cache[i], pos,
+                scale=1.0 / float(hd) ** 0.5)
+        else:
+            att = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache[i]).astype(
+                jnp.float32) / jnp.sqrt(hd)
+            att = jnp.where(mask[:, None], att, neg)
+            att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
+            y = jnp.einsum("bhqk,bhkd->bhqd", att, v_cache[i])
         y = y.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_embd)
         x = x + _dense(y, blk["attn"]["c_proj"])
         h = _ln(x, blk["ln_2"], eps)
@@ -160,7 +201,13 @@ def _sample(logits, rng, temperature, top_k):
 def _generate_jit(params, cfg, prompt_ids, max_new_tokens, temperature,
                   top_k, rng, eos_token_id):
     B, Tp = prompt_ids.shape
-    cache = init_cache(cfg, B, Tp + max_new_tokens)
+    cache_len = Tp + max_new_tokens
+    if cfg.use_flash_decode:
+        # Round the cache plane up to the kernel's block quantum so the
+        # fused path engages; padded positions sit past every frontier
+        # (masked, never embedded), so the extra plane is inert.
+        cache_len = decode_attention.pad_cache_len(cache_len)
+    cache = init_cache(cfg, B, cache_len)
     logits, cache = _forward(params, cfg, prompt_ids, cache,
                              last_only=True)                   # prefill
     rng0, rng = jax.random.split(rng)

@@ -22,6 +22,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.utils import jax_compat
+
 
 @dataclasses.dataclass
 class GPT2Config:
@@ -40,6 +42,12 @@ class GPT2Config:
     # and beats XLA's dense attention on v5e (355M shapes: 4.5 vs 9.5
     # ms/layer fwd+bwd at T=1024, 9.7 vs 29.3 at T=2048) — on by default.
     use_flash_attention: bool = True
+    # Decode-time (KV-cache) attention kernel for models/generation.py and
+    # the serving engine: True forces the Pallas flash-decode kernel,
+    # False forces the dense einsum path, None defers to
+    # generation.default_flash_decode() (on-TPU by default; the
+    # DS_TPU_FLASH_DECODE env overrides).
+    use_flash_decode: Optional[bool] = None
     # Sequence (context) parallelism: name of the mesh axis the sequence
     # dim is sharded over. When set AND the model runs inside shard_map
     # with that axis bound (the engine's sequence_parallel config does
@@ -189,10 +197,10 @@ class GPT2LMHeadModel(nn.Module):
             # sequence: offset the position table slice. The GLOBAL length
             # must fit the table — dynamic_slice would silently clamp an
             # out-of-range start to reuse early positions.
-            assert jax.lax.axis_size(sp) * T <= cfg.n_positions, (
+            assert jax_compat.axis_size(sp) * T <= cfg.n_positions, (
                 "global sequence {} ({} shards x {} local) exceeds "
-                "n_positions={}".format(jax.lax.axis_size(sp) * T,
-                                        jax.lax.axis_size(sp), T,
+                "n_positions={}".format(jax_compat.axis_size(sp) * T,
+                                        jax_compat.axis_size(sp), T,
                                         cfg.n_positions))
             pos0 = jax.lax.axis_index(sp) * T
             pe = jax.lax.dynamic_slice(wpe, (pos0, 0), (T, cfg.n_embd))
@@ -244,7 +252,7 @@ def _sequence_parallel_xent(x, wte, labels, cfg, axis):
     """
     from deepspeed_tpu.models.heads import chunked_tied_softmax_xent
 
-    n = jax.lax.axis_size(axis)
+    n = jax_compat.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     # Shard i receives shard (i+1)'s first label (source j sends to j-1).
     perm = [(i, (i - 1) % n) for i in range(n)]

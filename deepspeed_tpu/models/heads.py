@@ -155,8 +155,12 @@ def chunked_tied_softmax_xent(x, wte, labels, dtype, chunk=2048, bias=None,
     chunk = min(chunk, max(128, -(-n // 128) * 128))
     pad = (-n) % chunk
     if pad:
-        xf = jnp.concatenate([xf, jnp.zeros((pad, c), xf.dtype)], axis=0)
-        lf = jnp.concatenate([lf, jnp.zeros((pad,), lf.dtype)])
+        # jnp.pad, NOT concatenate-with-zeros: GSPMD on the CPU backend
+        # miscompiles concat when the rows arrive from a reshape of a
+        # sequence-sharded [B, T, C] (values scrambled, loss goes NaN —
+        # the sp + train_batch path). Pad lowers to a correct program.
+        xf = jnp.pad(xf, ((0, pad), (0, 0)))
+        lf = jnp.pad(lf, ((0, pad),))
     valid = (jnp.arange(n + pad) < n)
     if ignore_index is not None:
         valid = valid & (lf != ignore_index)

@@ -84,6 +84,58 @@ def _time_candidate(run, repeats):
     return best
 
 
+# Hardware tile quantum per kernel family: every block size in a table
+# entry must be a positive multiple of its family's minimum (one 128-lane
+# row). Families not listed here only get the positive-int check.
+_KERNEL_MIN_BLOCK = {
+    "flash_attention": 128,
+    "decode_attention": 128,
+}
+
+
+def validate_table(table, source="autotune table"):
+    """Schema-check a tile table (the bundled file or a user cache dump):
+    every key must parse as ``platform::kernel::signature`` with non-empty
+    parts, every entry must be a dict with a ``choice`` list of positive
+    ints, and kernels with a known tile quantum (_KERNEL_MIN_BLOCK)
+    additionally require each block to be a positive multiple of it.
+    Raises ValueError naming the offending key; returns the number of
+    entries checked. Guards hand-edits from hardware sweeps — a malformed
+    entry would otherwise break kernel dispatch at serving time
+    (tests/unit/test_autotune_table.py runs this over the bundled file)."""
+    if not isinstance(table, dict):
+        raise ValueError("{}: expected a JSON object at top level, got "
+                         "{}".format(source, type(table).__name__))
+    for key, entry in table.items():
+        parts = key.split("::")
+        if len(parts) != 3 or not all(parts):
+            raise ValueError(
+                "{}: key {!r} does not parse as "
+                "platform::kernel::signature".format(source, key))
+        kernel = parts[1]
+        if not isinstance(entry, dict) or "choice" not in entry:
+            raise ValueError(
+                "{}: entry for {!r} must be an object with a 'choice' "
+                "list".format(source, key))
+        choice = entry["choice"]
+        blocks = choice if isinstance(choice, list) else [choice]
+        if not blocks:
+            raise ValueError(
+                "{}: entry for {!r} has an empty choice".format(source, key))
+        min_block = _KERNEL_MIN_BLOCK.get(kernel)
+        for blk in blocks:
+            if isinstance(blk, bool) or not isinstance(blk, int) or blk <= 0:
+                raise ValueError(
+                    "{}: entry for {!r} has non-positive-int block "
+                    "{!r}".format(source, key, blk))
+            if min_block and blk % min_block:
+                raise ValueError(
+                    "{}: entry for {!r} has block {} not a multiple of "
+                    "{}'s minimum {}".format(source, key, blk, kernel,
+                                             min_block))
+    return len(table)
+
+
 def table_key(kernel, signature):
     """The full table key for (current backend, kernel, signature) —
     the single place the key format lives, so sweep/promotion scripts

@@ -2,6 +2,8 @@
 
 from deepspeed_tpu.ops.transformer.kernels.attention import (  # noqa: F401
     flash_attention, mha_reference)
+from deepspeed_tpu.ops.transformer.kernels.decode_attention import (  # noqa: F401,E501
+    decode_attention_reference, flash_decode_attention)
 from deepspeed_tpu.ops.transformer.kernels.dropout import (  # noqa: F401
     dropout, fused_bias_dropout_residual)
 from deepspeed_tpu.ops.transformer.kernels.gelu import (  # noqa: F401

@@ -938,6 +938,23 @@ def _bh_spec(sharding):
     return spec[0], spec[1]
 
 
+def _def_partition(cp, partition, infer, rule, factors):
+    """def_partition across jax versions: newer jax accepts a Shardy
+    ``sharding_rule`` (+ ``need_replication_factors``); jax 0.4.37's
+    def_partition takes neither and relies on the GSPMD callbacks alone.
+    Feature-detect so the same wrapper works on both."""
+    import inspect
+    params = inspect.signature(
+        custom_partitioning.def_partition).parameters
+    kw = {}
+    if "sharding_rule" in params:
+        kw["sharding_rule"] = rule
+        if "need_replication_factors" in params:
+            kw["need_replication_factors"] = factors
+    cp.def_partition(partition=partition,
+                     infer_sharding_from_operands=infer, **kw)
+
+
 def _cp_wrap(fn, n_in, n_out, rule, mask_pos=None):
     """Wrap fn (shard-local pallas launcher) in custom_partitioning with
     b/h-parallel shardings. Inputs/outputs are [B, H, ...] except an
@@ -961,13 +978,9 @@ def _cp_wrap(fn, n_in, n_out, rule, mask_pos=None):
         args, outs = shardings(mesh, arg_shapes[0].sharding)
         return mesh, fn, (outs if n_out > 1 else outs[0]), args
 
-    cp.def_partition(
-        partition=partition,
-        infer_sharding_from_operands=infer,
-        sharding_rule=rule,
-        # Ordered by first appearance in the rule (Shardy requires sorted
-        # factor indices): t then d (from q), s (from k), u (from lse).
-        need_replication_factors=("t", "d", "s", "u"))
+    # Factors ordered by first appearance in the rule (Shardy requires
+    # sorted factor indices): t then d (from q), s (from k), u (from lse).
+    _def_partition(cp, partition, infer, rule, ("t", "d", "s", "u"))
     return cp
 
 

@@ -1,0 +1,400 @@
+"""Flash-decode — length-aware fused cache attention for the slotted KV pool.
+
+Serving reads attention differently than training writes it: the query is
+one token (or one short prompt bucket) per row, the keys are a pre-allocated
+``[B, H, max_len, D]`` cache plane, and each row has its own sequence
+FRONTIER ``pos`` — row b's keys occupy ``0 .. pos[b]+S-1`` and everything
+past that is stale garbage a future request will overwrite. The einsum path
+in ``models/generation.py`` scores the query against the FULL plane in
+fp32, materializes ``[B, H, S, max_len]`` scores and softmaxes over the
+whole length, even when the frontier sits at position 30 of a 2048-slot
+cache.
+
+This kernel fuses QK-score, online softmax and the value GEMM in one
+Pallas program, blocked along the length dimension, with PER-ROW frontier
+awareness via scalar prefetch:
+
+- ``pos`` rides a ``PrefetchScalarGridSpec`` scalar operand, so the kv
+  BLOCK INDEX MAP can read it: blocks past ``(pos[b]+S-1) // block_k``
+  clamp to the last useful block (a repeated index issues no new DMA) and
+  ``@pl.when`` skips their compute — the same trick the training kernel
+  uses for causal skip, but against a runtime frontier instead of the
+  static diagonal;
+- scores never leave VMEM: online-softmax statistics live in fp32 scratch
+  across the split-KV grid steps, and the row-sum rides the PV matmul
+  (``_pv_rowsum``) exactly as in the training kernel;
+- the frontier mask only costs a compare/select pass on the one block that
+  STRADDLES a row's frontier; fully-visible interior blocks skip it;
+- q is pre-scaled by 1/sqrt(d) outside the kernel, and decode's S=1 query
+  is padded up to the Mosaic sublane minimum (8 fp32 / 16 bf16) so the
+  [S, block_k] score tile is always a legal VMEM shape.
+
+The cache plane length must be a multiple of ``BLOCK_MIN`` (128 lanes);
+``inference/kv_pool.py`` pads its pool to that quantum and
+``flash_decode_attention`` falls back to the dense reference for
+unsupported shapes. Off-TPU the kernel runs in Pallas interpret mode, so
+CPU tests exercise the same code path (parity pinned by
+``tests/unit/test_decode_attention.py``).
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.custom_partitioning import custom_partitioning
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.transformer.kernels.attention import (
+    NEG_INF,
+    _STATS_LANES,
+    _bh_spec,
+    _def_partition,
+    _exp_lowp,
+    _interpret,
+    _is_lowp,
+    _mxu_precision,
+    _pv_rowsum,
+    _use_custom_partitioning,
+)
+
+# Length-dimension tile quantum: one 128-lane row of the score tile. The
+# kv pool pads max_len to a multiple of this so the kernel always engages.
+BLOCK_MIN = 128
+
+_DEFAULT_BLOCK_K = 256
+
+
+def pad_cache_len(max_len):
+    """Smallest multiple of BLOCK_MIN covering ``max_len`` — the cache
+    plane length flash-decode requires (padding a plane is inert: the
+    frontier never reaches padded positions, so they are always masked)."""
+    return -(-int(max_len) // BLOCK_MIN) * BLOCK_MIN
+
+
+def decode_supported(t_kv):
+    """Can the kernel take a cache plane of length ``t_kv``?"""
+    return t_kv % BLOCK_MIN == 0
+
+
+def _sublane(dtype):
+    """Mosaic's minimum second-minor tile extent: score tiles narrower than
+    this are padded anyway, so the launcher pads the QUERY dim explicitly
+    and slices the output (decode's S=1 would otherwise hand Mosaic a
+    1-row tile)."""
+    return 16 if _is_lowp(dtype) else 8
+
+
+def decode_signature(b, h, s, t_kv, d, dtype):
+    """Autotune-table signature for a decode-attention shape. Exported so
+    the sweep/promotion script (tests/perf/autotune_sweep.py) shares the
+    exact format and cannot silently drop entries if it changes."""
+    return "b{}_h{}_s{}_t{}_d{}_{}".format(
+        b, h, s, t_kv, d, jnp.dtype(dtype).name)
+
+
+# ---------------------------------------------------------------------------
+# Reference (pure jnp) — ground truth for parity tests and the fallback for
+# shapes the kernel does not support. Mirrors models/generation.py's cache
+# attention (einsum scores over the full plane, frontier mask, fp32
+# softmax) so flag-off and fallback paths are the SAME math.
+# ---------------------------------------------------------------------------
+
+def decode_attention_reference(q, k, v, pos, scale=None):
+    """q: [B, H, S, D] query rows, row b starting at global position
+    ``pos[b]`` (its k/v already written at ``pos[b] .. pos[b]+S-1``);
+    k, v: [B, H, T, D] cache planes; pos: [B] int32 frontiers.
+    Key t is visible to query row i iff ``t <= pos[b] + i`` — the causal
+    mask against each row's GLOBAL position, which also excludes every
+    stale position past the frontier. Returns [B, H, S, D] in q.dtype."""
+    B, H, S, D = q.shape
+    T = k.shape[2]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    prec = _mxu_precision(q.dtype)
+    q_pos = pos[:, None] + jnp.arange(S)[None]               # [B, S]
+    mask = jnp.arange(T)[None, None, :] <= q_pos[:, :, None]  # [B, S, T]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32), precision=prec) * scale
+    s = jnp.where(mask[:, None], s, jnp.finfo(jnp.float32).min)
+    att = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", att, v, precision=prec)
+
+
+# ---------------------------------------------------------------------------
+# Kernel
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *scratch,
+                   s_len, block_k, single_kv):
+    b_ = pl.program_id(0)
+    j = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+    pos_b = pos_ref[b_]
+    # Last kv block holding any key visible to this row's queries: the
+    # frontier analogue of the training kernel's _last_kv_block(iq).
+    last = (pos_b + s_len - 1) // block_k
+
+    def scores():
+        s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=_mxu_precision(q_ref.dtype))
+
+        def straddling():
+            # Key col (global j*block_k + c) visible to query row i
+            # (global pos_b + i) iff k_pos <= q_pos. Padded query rows
+            # (i >= s_len) compute garbage the launcher slices off.
+            q_pos = pos_b + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            return jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+        # Interior blocks (every key visible to even the FIRST query row)
+        # skip the iota/compare/select pass — only the block straddling the
+        # frontier pays for masking.
+        return jax.lax.cond((j + 1) * block_k - 1 <= pos_b,
+                            lambda: s, straddling)
+
+    if single_kv:
+        # One kv block: direct softmax, no scratch, no rescale passes.
+        s = scores()
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = _exp_lowp(s - m, o_ref.dtype)
+        pv, l = _pv_rowsum(p, v_ref[0, 0])
+        l = jnp.maximum(l, 1e-30)
+        o_ref[0, 0] = (pv / l).astype(o_ref.dtype)
+        return
+
+    acc, m_s, l_s = scratch
+
+    @pl.when(j == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+
+    @pl.when(j <= last)
+    def _compute():
+        s = scores()
+        m_prev = m_s[:, 0:1]
+        l_prev = l_s[:, 0:1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = _exp_lowp(s - m_new, o_ref.dtype)
+        pv, l_cur = _pv_rowsum(p, v_ref[0, 0])
+        l_new = alpha * l_prev + l_cur
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+        acc[...] = acc[...] * alpha + pv
+
+    # The grid is dense (skipped blocks still step), so the last step
+    # always runs and can finalize unconditionally.
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        l = jnp.maximum(l_s[:, 0:1], 1e-30)
+        o_ref[0, 0] = (acc[...] / l).astype(o_ref.dtype)
+
+
+def _flash_decode_pallas(q, k, v, pos, scale, block_k):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    t_kv = k.shape[2]
+    n_kv = t_kv // block_k
+    # Pre-scale q: one [S, d] pass replaces a [S, T] pass per kernel.
+    q = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    pos = pos.astype(jnp.int32)
+    # Pad the query dim up to the sublane minimum (decode is S=1); padded
+    # rows compute garbage that is sliced off below.
+    sub = _sublane(q.dtype)
+    s_blk = -(-s // sub) * sub
+    if s_blk != s:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, s_blk - s), (0, 0)))
+
+    def kv_index(b_, h_, j, pos_ref):
+        # Clamp past-frontier blocks to the last useful one: a repeated
+        # block index issues no new DMA, and @pl.when skips the compute.
+        last = (pos_ref[b_] + s - 1) // block_k
+        return (b_, h_, jnp.minimum(j, last), 0)
+
+    def q_index(b_, h_, j, pos_ref):
+        return (b_, h_, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, 1, s_blk, d), q_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, s_blk, d), q_index),
+        scratch_shapes=[] if n_kv == 1 else [
+            pltpu.VMEM((s_blk, d), jnp.float32),
+            pltpu.VMEM((s_blk, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((s_blk, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, s_len=s, block_k=block_k,
+                          single_kv=n_kv == 1),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, s_blk, d), q.dtype),
+        interpret=_interpret(),
+    )(pos, q, k, v)
+    return out[:, :, :s] if s_blk != s else out
+
+
+# ---------------------------------------------------------------------------
+# Block selection — autotuner integration (kernel family
+# "decode_attention"; see ops/autotuner.py and tests/perf/autotune_sweep.py)
+# ---------------------------------------------------------------------------
+
+def _block_candidates(t_kv):
+    return [bk for bk in (128, 256, 512) if bk <= t_kv and t_kv % bk == 0]
+
+
+def _autotuned_block(shape, dtype, cands, default, arrays=None):
+    """Consult the autotuner for a decode block size. ``arrays`` (q, k, v,
+    pos concrete values) enables an online sweep under DS_TPU_AUTOTUNE;
+    without them (traced engine calls, bench stamping) only the
+    bundled/user tables are consulted. The sweep times the WORST-CASE
+    frontier (pos = t - s: every block active) so the tuned tile is the
+    one the end of a long generation runs on."""
+    from deepspeed_tpu.ops import autotuner
+
+    b, h, s, t_kv, d = shape
+    sig = decode_signature(b, h, s, t_kv, d, dtype)
+    cand_lists = [[c] for c in cands] if arrays is not None else []
+
+    def make_run(cand):
+        (bk,) = cand
+        q, k, v, _ = arrays
+        pos = jnp.full((b,), t_kv - s, jnp.int32)
+        scale = 1.0 / (d ** 0.5)
+        jitted = jax.jit(functools.partial(
+            _flash_decode_pallas, scale=scale, block_k=int(bk)))
+
+        def run():
+            return jitted(q, k, v, pos)
+        return run
+
+    choice = autotuner.autotune("decode_attention", sig, cand_lists,
+                                make_run, default=[default])
+    bk = int(choice[0] if isinstance(choice, (list, tuple)) else choice)
+    # A hand-edited table entry must not break dispatch: reject tiles the
+    # kernel cannot take and fall back to the default.
+    return bk if bk >= 1 and t_kv % bk == 0 else default
+
+
+def planned_block_k(b, h, s, t_kv, d, dtype):
+    """Table-or-default block_k for a decode shape WITHOUT running a sweep
+    (bench stamping / observability). None when the kernel cannot take the
+    shape at all."""
+    if not decode_supported(t_kv):
+        return None
+    cands = _block_candidates(t_kv)
+    default = _DEFAULT_BLOCK_K if _DEFAULT_BLOCK_K in cands else cands[-1]
+    return _autotuned_block((b, h, s, t_kv, d), dtype, cands, default)
+
+
+def resolve_decode_block(q, k, block_k=None, v=None, pos=None):
+    """The ONE block-selection policy for flash_decode_attention: an
+    explicit ``block_k`` (arg or DS_TPU_FLASH_DECODE_BLOCK env, for tests
+    and A/B experiments) is honored when legal; otherwise the autotuner
+    table / default — with an online sweep when the call is eager on TPU
+    and DS_TPU_AUTOTUNE is on (v/pos supply the sweep operands). Returns
+    None when the shape must take the dense fallback."""
+    import jax.core
+
+    t_kv = k.shape[2]
+    if block_k is None:
+        env_bk = os.environ.get("DS_TPU_FLASH_DECODE_BLOCK", "")
+        if env_bk:
+            block_k = int(env_bk)
+    if block_k is not None:
+        bk = min(int(block_k), t_kv)
+        return bk if bk >= 1 and t_kv % bk == 0 else None
+    if not decode_supported(t_kv):
+        return None
+    b, h, s, d = q.shape
+    cands = _block_candidates(t_kv)
+    default = _DEFAULT_BLOCK_K if _DEFAULT_BLOCK_K in cands else cands[-1]
+    traced = any(isinstance(x, jax.core.Tracer)
+                 for x in (q, k, v, pos) if x is not None)
+    arrays = None
+    if not traced and not _interpret() and v is not None and pos is not None:
+        arrays = (q, k, v, pos)
+    return _autotuned_block((b, h, s, t_kv, d), q.dtype, cands, default,
+                            arrays=arrays)
+
+
+# ---------------------------------------------------------------------------
+# GSPMD integration — batch/head-parallel partitioning, mirroring
+# attention.py's _cp_wrap (b/h follow the operand sharding, length and
+# head-dim replicate; pos is a [B] vector sharded like the batch dim).
+# Without the rule XLA would replicate the whole kv pool into every shard.
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _decode_partitioned(scale, block_k):
+    def f(q, k, v, pos):
+        return _flash_decode_pallas(q, k, v, pos, scale, block_k)
+
+    cp = custom_partitioning(f)
+
+    def shardings(mesh, q_sharding):
+        b, h = _bh_spec(q_sharding)
+        full = NamedSharding(mesh, P(b, h, None, None))
+        pos_sh = NamedSharding(mesh, P(b))
+        return (full, full, full, pos_sh), (full,)
+
+    def infer(mesh, arg_shapes, shape):
+        return shardings(mesh, arg_shapes[0].sharding)[1][0]
+
+    def partition(mesh, arg_shapes, result_shape):
+        args, outs = shardings(mesh, arg_shapes[0].sharding)
+        return mesh, f, outs[0], args
+
+    # Factors ordered by first appearance in the rule (Shardy requires
+    # sorted factor indices): t, d (from q), s (from k).
+    _def_partition(cp, partition, infer,
+                   "b h t d, b h s d, b h s d, b -> b h t d",
+                   ("t", "d", "s"))
+    return cp
+
+
+# ---------------------------------------------------------------------------
+# Public entry point
+# ---------------------------------------------------------------------------
+
+def flash_decode_attention(q, k, v, pos, scale=None, block_k=None):
+    """Length-aware fused cache attention over a slotted KV plane.
+
+    Args:
+      q: [B, H, S, D] query rows; row b's tokens sit at global positions
+        ``pos[b] .. pos[b]+S-1`` (S=1 in the decode scan, S=bucket in
+        prefill). The row's k/v must ALREADY be written into the plane —
+        the convention of models/generation.py's _forward, which writes
+        the cache before attending.
+      k, v: [B, H, T, D] cache planes; T must be a multiple of BLOCK_MIN
+        (128) for the kernel to engage (inference/kv_pool.py pads its
+        pool; unsupported T falls back to the dense reference).
+      pos: [B] int32 per-row frontiers (pre-write sequence lengths).
+      scale: score scale; default 1/sqrt(D).
+      block_k: length-dim tile; default consults the autotuner
+        ("decode_attention" family). DS_TPU_FLASH_DECODE_BLOCK overrides.
+    Returns: [B, H, S, D] in q.dtype.
+    """
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    bk = resolve_decode_block(q, k, block_k=block_k, v=v, pos=pos)
+    if bk is None:
+        return decode_attention_reference(q, k, v, pos, scale=scale)
+    if _use_custom_partitioning():
+        return _decode_partitioned(float(scale), int(bk))(q, k, v, pos)
+    return _flash_decode_pallas(q, k, v, pos, float(scale), int(bk))

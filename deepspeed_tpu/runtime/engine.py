@@ -990,7 +990,7 @@ class DeepSpeedEngine(object):
         (v0.3.10 has no sequence parallelism, SURVEY §0)."""
         from functools import partial
 
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
 
         mesh = self.mesh
         dp = mesh_lib.dp_size(mesh)
@@ -1097,7 +1097,7 @@ class DeepSpeedEngine(object):
         DP, engine.py:180-185,1186-1242)."""
         from functools import partial
 
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
 
         from deepspeed_tpu.runtime.csr_tensor import sparse_grad_exchange
 
@@ -1850,7 +1850,7 @@ class DeepSpeedEngine(object):
         static (a collective cannot live inside lax.cond), so the step
         re-traces once at the freeze boundary; train_batch keys its cache
         on the phase."""
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from deepspeed_tpu.runtime.fp16.onebit_adam import onebit_adam_update

@@ -42,6 +42,22 @@ from deepspeed_tpu.runtime.pipe.module import (
 from deepspeed_tpu.runtime.utils import ensure_directory_exists
 from deepspeed_tpu.utils.logging import log_dist, logger
 
+def _missing_dropout_rng(err):
+    """Is ``err`` flax's complaint about an unprovided 'dropout' PRNG
+    stream? Eval forwards pass no dropout rng BY DESIGN (deterministic
+    eval), so a layer that calls ``make_rng('dropout')`` unconditionally
+    fails here with a message that doesn't say which convention it broke —
+    _exec_forward_pass re-raises it with the pointer."""
+    try:
+        from flax.errors import InvalidRngError
+    except ImportError:  # flax layout change: fall back to the message
+        InvalidRngError = ()
+    msg = str(err)
+    if isinstance(err, InvalidRngError):
+        return "dropout" in msg
+    return "dropout" in msg and "rng" in msg.lower()
+
+
 def _is_flax_module(layer):
     return hasattr(layer, "init") and hasattr(layer, "apply")
 
@@ -292,7 +308,7 @@ class PipelineEngine(DeepSpeedEngine):
         freeze_step, onebit_adam.py:369-372)."""
         if stage_id in self._stage_bwd_local:
             return self._stage_bwd_local[stage_id]
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
 
         mesh = self.stage_meshes[stage_id]
         axis = mesh_lib.DATA_AXIS
@@ -664,8 +680,21 @@ class PipelineEngine(DeepSpeedEngine):
             # eval: no dropout rng — layers keying on has_rng("dropout")
             # run deterministically (the reference eval_batch flips
             # module.eval() the same way).
-            out = self._get_stage_fn(stage_id, with_dropout=False)(
-                params_list, x, labels, rng)
+            try:
+                out = self._get_stage_fn(stage_id, with_dropout=False)(
+                    params_list, x, labels, rng)
+            except Exception as e:
+                if not _missing_dropout_rng(e):
+                    raise
+                raise RuntimeError(
+                    "pipeline eval forward on stage {} failed because a "
+                    "layer requested the 'dropout' PRNG, which eval_batch "
+                    "does not provide. Gate the make_rng('dropout') call "
+                    "on self.has_rng('dropout') and run deterministically "
+                    "when it is absent — the train/eval contract in "
+                    "docs/tutorials/pipeline.md ('The dropout rng "
+                    "contract for pipeline layers').".format(stage_id)
+                ) from e
         buf["outputs"][cmd.buffer_id] = out
         if stage_id == self.num_stages - 1:
             # Reference semantics (pipe/engine.py:537-543): with a loss_fn the
@@ -809,7 +838,7 @@ class PipelineEngine(DeepSpeedEngine):
 
             fn = jax.jit(multi, donate_argnums=(0, 2))
         else:
-            from jax import shard_map
+            from deepspeed_tpu.utils.jax_compat import shard_map
 
             from deepspeed_tpu.runtime.fp16.onebit_adam import (
                 onebit_adam_update)

@@ -1,0 +1,40 @@
+"""Version shims for jax API drift.
+
+The codebase targets current jax, where ``shard_map`` is a top-level
+export whose replication check is spelled ``check_vma``. On the older
+jax still found in some TPU images the function lives in
+``jax.experimental.shard_map`` and the same flag is ``check_rep``.
+Import ``shard_map`` from here instead of from jax and pass
+``check_vma=`` — the wrapper renames the flag for whichever jax is
+installed. (The same feature-detect approach covers
+``custom_partitioning.def_partition``'s sharding-rule kwargs in
+ops/transformer/kernels/attention.py::_def_partition.)
+"""
+
+try:
+    from jax import shard_map as _shard_map
+    _REP_ARG = "check_vma"
+except ImportError:  # older jax keeps it under experimental, as check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _REP_ARG = "check_rep"
+
+
+def shard_map(f, *, check_vma=None, check_rep=None, **kw):
+    """``jax.shard_map`` with the replication-check flag translated to
+    whatever the installed jax calls it. ``check_vma`` and ``check_rep``
+    are aliases; passing neither defers to jax's default."""
+    flag = check_vma if check_vma is not None else check_rep
+    if flag is not None:
+        kw[_REP_ARG] = flag
+    return _shard_map(f, **kw)
+
+
+def axis_size(axis_name):
+    """Static size of a mapped axis (``jax.lax.axis_size`` on current
+    jax; older jax exposes it as the value of ``core.axis_frame``)."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    from jax import core
+    return int(core.axis_frame(axis_name))

@@ -39,3 +39,7 @@ def eight_devices():
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "tpu_only: requires real TPU hardware")
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute model-tier training runs, excluded from the "
+        "tier-1 sweep (-m 'not slow'); run tests/model explicitly")

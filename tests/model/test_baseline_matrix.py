@@ -37,6 +37,12 @@ import pytest
 
 import deepspeed_tpu as deepspeed
 
+# Model-tier: each case trains a ~13M GPT-2 for 30 steps on the
+# CPU mesh (minutes per case now that the flash kernels run in
+# interpret mode there) -- far past the tier-1 time budget, so the
+# whole tier is opt-in: pytest tests/model -m slow (or --regen).
+pytestmark = pytest.mark.slow
+
 BASELINE_DIR = os.path.join(os.path.dirname(__file__), "baselines")
 STEPS = 30
 BATCH, SEQ = 8, 64

@@ -14,6 +14,12 @@ import pytest
 
 import deepspeed_tpu as deepspeed
 
+# Model-tier: each case trains a ~13M GPT-2 for 30 steps on the
+# CPU mesh (minutes per case now that the flash kernels run in
+# interpret mode there) -- far past the tier-1 time budget, so the
+# whole tier is opt-in: pytest tests/model -m slow (or --regen).
+pytestmark = pytest.mark.slow
+
 
 def _train_gpt2(config_extra, steps=60, seed=0):
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel

@@ -1,16 +1,24 @@
-"""Re-sweep the flash-attention tile autotuner on the GPT-2 bench shapes
-and refresh the bundled table.
+"""Re-sweep the kernel tile autotuner on the bench shapes and refresh the
+bundled table.
 
 The bundled table (`deepspeed_tpu/ops/autotune_table.json`) was swept
 with the split two-kernel backward; the fused one-pass backward changes
 the cost surface (no kv-innermost grid in the backward), so the winning
-tiles may shift. This script runs the online sweep eagerly (the
-autotuner only sweeps outside a trace) for each (batch, seq) the bench
-battery exercises, then copies the winners from the user cache into the
-bundled table so the jitted engine path — which consults tables only —
-picks them up.
+tiles may shift — each flash-attention sweep candidate is timed through
+a full fwd+bwd step (see attention._autotuned_blocks' make_run), so a
+re-run under this script refreshes the table against whichever backward
+mode ('fused'/'split', printed per shape) the current kernels pick. The
+script also sweeps the flash-DECODE kernel
+(ops/transformer/kernels/decode_attention.py) at the serving shapes, so
+the inference engine's traced calls — which consult tables only — pick
+up tuned kv tiles.
 
-Usage: python tests/perf/autotune_sweep.py [--shapes b8t1024,b4t2048,...]
+Runs the online sweeps eagerly (the autotuner only sweeps outside a
+trace), then copies the winners from the user cache into the bundled
+table, schema-validating the result before writing.
+
+Usage: python tests/perf/autotune_sweep.py
+           [--shapes b8t1024,b4t2048,...] [--decode-shapes b16t1024,...]
 """
 
 import argparse
@@ -32,24 +40,26 @@ import numpy as np
 
 from deepspeed_tpu.ops import autotuner
 from deepspeed_tpu.ops.transformer.kernels.attention import (
-    flash_attention, flash_signature)
+    _bwd_mode, flash_attention, flash_signature)
+from deepspeed_tpu.ops.transformer.kernels.decode_attention import (
+    decode_signature, flash_decode_attention)
 
 # (batch, seq) grid — matches bench.py --sweep; heads/dim are GPT-2
 # medium's (the autotune signature keys on the full shape).
 DEFAULT_SHAPES = "b8t1024,b12t1024,b16t1024,b4t2048,b8t2048,b2t4096,b4t4096"
 
+# (slots, cache plane len) decode grid — bench.py --serve runs 16 slots
+# at a 1024-position pool; the longer planes cover larger serving
+# configs. S=1: the decode scan's query shape.
+DEFAULT_DECODE_SHAPES = "b16t1024,b16t2048,b8t2048,b8t4096"
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--shapes", default=DEFAULT_SHAPES)
-    ap.add_argument("--heads", type=int, default=16)
-    ap.add_argument("--dim", type=int, default=64)
-    args = ap.parse_args()
 
+def sweep_flash(args, swept_keys):
     rng = np.random.RandomState(0)
-    swept_keys = []
     for spec in args.shapes.split(","):
         spec = spec.strip()
+        if not spec:
+            continue
         b, t = (int(x) for x in spec[1:].split("t"))
         q, k, v = (jnp.asarray(rng.randn(b, args.heads, t, args.dim),
                                jnp.bfloat16) for _ in range(3))
@@ -62,7 +72,43 @@ def main():
             "flash_attention",
             flash_signature(b, args.heads, t, t, args.dim,
                             jnp.bfloat16, causal=True)))
-        print("swept", spec, flush=True)
+        print("swept", spec, "(backward mode: {})".format(
+            _bwd_mode(t, args.dim, jnp.bfloat16)), flush=True)
+
+
+def sweep_decode(args, swept_keys):
+    rng = np.random.RandomState(1)
+    for spec in args.decode_shapes.split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        b, t = (int(x) for x in spec[1:].split("t"))
+        q = jnp.asarray(rng.randn(b, args.heads, 1, args.dim), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(b, args.heads, t, args.dim), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(b, args.heads, t, args.dim), jnp.bfloat16)
+        # Worst-case frontier (t-1: every kv block active) — the sweep
+        # inside resolve_decode_block times the same frontier, so the
+        # tuned tile is the end-of-generation one.
+        pos = jnp.full((b,), t - 1, jnp.int32)
+        out = flash_decode_attention(q, k, v, pos)
+        out.block_until_ready()
+        swept_keys.append(autotuner.table_key(
+            "decode_attention",
+            decode_signature(b, args.heads, 1, t, args.dim, jnp.bfloat16)))
+        print("swept decode", spec, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shapes", default=DEFAULT_SHAPES)
+    ap.add_argument("--decode-shapes", default=DEFAULT_DECODE_SHAPES)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--dim", type=int, default=64)
+    args = ap.parse_args()
+
+    swept_keys = []
+    sweep_flash(args, swept_keys)
+    sweep_decode(args, swept_keys)
 
     user_path = autotuner._user_cache_path()
     try:
@@ -89,6 +135,8 @@ def main():
         if bundled.get(key, {}).get("choice") != entry["choice"]:
             changed += 1
         bundled[key] = entry
+    # A malformed merge must die here, not at serving-time dispatch.
+    autotuner.validate_table(bundled, source=bundled_path)
     with open(bundled_path, "w") as f:
         json.dump(bundled, f, indent=1, sort_keys=True)
         f.write("\n")

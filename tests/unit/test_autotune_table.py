@@ -1,0 +1,80 @@
+"""Schema validation for the bundled autotune tile table
+(deepspeed_tpu/ops/autotune_table.json) via autotuner.validate_table —
+the guard that keeps hand-edits and sweep-script merges
+(tests/perf/autotune_sweep.py) from shipping entries that break kernel
+dispatch at serving time."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.ops import autotuner
+
+
+def test_bundled_table_passes_schema():
+    with open(autotuner._BUNDLED_PATH) as f:
+        table = json.load(f)
+    assert autotuner.validate_table(table, source="bundled") == len(table)
+
+
+GOOD_KEY = "tpu::flash_attention::b8_h16_tq1024_tkv1024_d64_bf16_cTrue"
+DECODE_KEY = "tpu::decode_attention::b16_h16_s1_t1024_d64_bfloat16"
+
+
+def test_valid_entries_pass():
+    n = autotuner.validate_table({
+        GOOD_KEY: {"choice": [256, 512], "seconds": 0.001},
+        DECODE_KEY: {"choice": [256]},
+        # Unknown kernel family: positive ints suffice (no tile quantum).
+        "cpu::some_future_kernel::sig": {"choice": [3]},
+    })
+    assert n == 3
+
+
+def test_top_level_must_be_object():
+    with pytest.raises(ValueError, match="JSON object"):
+        autotuner.validate_table([1, 2, 3])
+
+
+@pytest.mark.parametrize("key", [
+    "flash_attention::sig",        # two parts
+    "tpu::flash_attention",        # two parts again
+    "tpu::::sig",                  # empty kernel part
+    "::flash_attention::sig",      # empty platform part
+])
+def test_malformed_keys_rejected(key):
+    with pytest.raises(ValueError, match="does not parse"):
+        autotuner.validate_table({key: {"choice": [128]}})
+
+
+@pytest.mark.parametrize("entry", [
+    [128, 128],                    # bare list, no dict
+    {},                            # missing choice
+    {"winner": [128]},             # wrong field name
+])
+def test_entry_must_be_dict_with_choice(entry):
+    with pytest.raises(ValueError, match="'choice'"):
+        autotuner.validate_table({GOOD_KEY: entry})
+
+
+def test_empty_choice_rejected():
+    with pytest.raises(ValueError, match="empty choice"):
+        autotuner.validate_table({GOOD_KEY: {"choice": []}})
+
+
+@pytest.mark.parametrize("block", [0, -128, 128.0, "128", True])
+def test_non_positive_int_blocks_rejected(block):
+    with pytest.raises(ValueError, match="non-positive-int"):
+        autotuner.validate_table({GOOD_KEY: {"choice": [block]}})
+
+
+@pytest.mark.parametrize("key", [GOOD_KEY, DECODE_KEY])
+def test_blocks_must_be_multiples_of_kernel_minimum(key):
+    # 192 is a positive int but not a multiple of the 128 tile quantum
+    # either attention family requires.
+    with pytest.raises(ValueError, match="multiple"):
+        autotuner.validate_table({key: {"choice": [192]}})
+    # Scalar (non-list) choices are checked under the same rule.
+    with pytest.raises(ValueError, match="multiple"):
+        autotuner.validate_table({key: {"choice": 192}})
+    assert autotuner.validate_table({key: {"choice": 256}}) == 1

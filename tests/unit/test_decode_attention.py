@@ -1,0 +1,231 @@
+"""Flash-decode kernel (ops/transformer/kernels/decode_attention.py) —
+parity against the dense einsum reference over RAGGED frontiers, and
+through the decode-step program in models/generation.py. Off-TPU the
+Pallas kernel runs in interpret mode, so these tests exercise the real
+kernel body (masking, online-softmax rescale, block clamping) on CPU."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.generation import (
+    _forward, as_gencfg, decode_step, generate, init_cache)
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.ops.transformer.kernels.decode_attention import (
+    BLOCK_MIN, decode_attention_reference, decode_supported,
+    flash_decode_attention, pad_cache_len, planned_block_k,
+    resolve_decode_block)
+
+
+def qkv(rng, b, h, s, t, d, dtype=jnp.float32):
+    q = jnp.asarray(rng.randn(b, h, s, d), dtype)
+    k = jnp.asarray(rng.randn(b, h, t, d), dtype)
+    v = jnp.asarray(rng.randn(b, h, t, d), dtype)
+    return q, k, v
+
+
+# ------------------------------------------------------------ kernel parity
+
+
+@pytest.mark.parametrize("block_k", [64, 128])
+def test_decode_parity_ragged_frontiers(block_k):
+    """S=1 decode rows at wildly different frontiers — including 0 (only
+    the row's own key visible) and T-1 (every block active) — in one
+    batch: the per-row clamp/mask must hold independently per row."""
+    rng = np.random.RandomState(0)
+    b, h, t, d = 4, 2, 256, 32
+    q, k, v = qkv(rng, b, h, 1, t, d)
+    pos = jnp.asarray([0, 3, 128, 255], jnp.int32)
+    out = flash_decode_attention(q, k, v, pos, block_k=block_k)
+    ref = decode_attention_reference(q, k, v, pos)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_decode_parity_under_jit():
+    rng = np.random.RandomState(1)
+    q, k, v = qkv(rng, 3, 2, 1, 128, 16)
+    pos = jnp.asarray([5, 63, 127], jnp.int32)
+    f = jax.jit(lambda *a: flash_decode_attention(*a, block_k=64))
+    np.testing.assert_allclose(f(q, k, v, pos),
+                               decode_attention_reference(q, k, v, pos),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_prefill_rows_non_sublane_aligned():
+    """S=24 (a prefill bucket, not a multiple of the 8-row sublane): the
+    launcher pads the query dim and slices the pad back off; the
+    intra-row causal stagger (key t visible to row i iff t <= pos+i)
+    must match the reference exactly."""
+    rng = np.random.RandomState(2)
+    b, h, s, t, d = 3, 2, 24, 128, 32
+    q, k, v = qkv(rng, b, h, s, t, d)
+    pos = jnp.asarray([0, 50, 104], jnp.int32)  # pos + s <= t
+    out = flash_decode_attention(q, k, v, pos, block_k=64)
+    ref = decode_attention_reference(q, k, v, pos)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_single_kv_block_path():
+    """block_k == T collapses to the direct-softmax branch (no scratch)."""
+    rng = np.random.RandomState(3)
+    q, k, v = qkv(rng, 2, 2, 1, 128, 32)
+    pos = jnp.asarray([0, 127], jnp.int32)
+    out = flash_decode_attention(q, k, v, pos, block_k=128)
+    ref = decode_attention_reference(q, k, v, pos)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_parity():
+    rng = np.random.RandomState(4)
+    q, k, v = qkv(rng, 2, 2, 1, 256, 32, jnp.bfloat16)
+    pos = jnp.asarray([7, 255], jnp.int32)
+    out = flash_decode_attention(q, k, v, pos, block_k=128)
+    ref = decode_attention_reference(q, k, v, pos)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_custom_scale_honored():
+    rng = np.random.RandomState(5)
+    q, k, v = qkv(rng, 2, 1, 1, 128, 16)
+    pos = jnp.asarray([64, 100], jnp.int32)
+    out = flash_decode_attention(q, k, v, pos, scale=0.5, block_k=64)
+    ref = decode_attention_reference(q, k, v, pos, scale=0.5)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------- block policy / fallback
+
+
+def test_pad_cache_len_and_supported():
+    assert pad_cache_len(1) == BLOCK_MIN
+    assert pad_cache_len(128) == 128
+    assert pad_cache_len(129) == 256
+    assert decode_supported(256) and not decode_supported(100)
+
+
+def test_unsupported_length_falls_back_to_reference():
+    """T not a multiple of BLOCK_MIN and no explicit block: the public
+    entry must return the dense reference, bit-for-bit."""
+    rng = np.random.RandomState(6)
+    q, k, v = qkv(rng, 2, 2, 1, 100, 16)
+    pos = jnp.asarray([0, 99], jnp.int32)
+    out = flash_decode_attention(q, k, v, pos)
+    ref = decode_attention_reference(q, k, v, pos)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_env_block_override(monkeypatch):
+    rng = np.random.RandomState(7)
+    q, k, v = qkv(rng, 2, 1, 1, 256, 16)
+    pos = jnp.asarray([10, 200], jnp.int32)
+    monkeypatch.setenv("DS_TPU_FLASH_DECODE_BLOCK", "64")
+    assert resolve_decode_block(q, k) == 64
+    out = flash_decode_attention(q, k, v, pos)
+    np.testing.assert_allclose(out, decode_attention_reference(q, k, v, pos),
+                               rtol=1e-5, atol=1e-5)
+    # An illegal override (does not divide T) means dense fallback, not
+    # a crash at pallas_call.
+    monkeypatch.setenv("DS_TPU_FLASH_DECODE_BLOCK", "96")
+    assert resolve_decode_block(q, k) is None
+
+
+def test_explicit_block_clamped_to_plane():
+    rng = np.random.RandomState(8)
+    q, k, _ = qkv(rng, 1, 1, 1, 128, 16)
+    assert resolve_decode_block(q, k, block_k=512) == 128  # min(bk, T)
+    assert resolve_decode_block(q, k, block_k=96) is None  # 128 % 96 != 0
+
+
+def test_planned_block_k_table_or_default():
+    # No table entry for this made-up shape: the default (256 when it
+    # divides T, else the largest legal candidate).
+    assert planned_block_k(2, 2, 1, 512, 32, jnp.float32) == 256
+    assert planned_block_k(2, 2, 1, 128, 32, jnp.float32) == 128
+    assert planned_block_k(2, 2, 1, 100, 32, jnp.float32) is None
+
+
+# ------------------------------------------- decode-step program parity
+
+
+def tiny_model(seed=0):
+    cfg = GPT2Config.tiny(dropout=0.0, dtype=jnp.float32,
+                          use_flash_attention=False)
+    model = GPT2LMHeadModel(cfg)
+    ids = np.random.RandomState(seed).randint(0, cfg.vocab_size,
+                                              size=(3, 12))
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))["params"]
+    return cfg, model, params, ids
+
+
+def test_decode_step_flag_parity_ragged():
+    """decode_step with flash on vs off at a 128-slot cache plane and
+    ragged per-row frontiers: fp32 logits match and greedy argmax is
+    IDENTICAL (the token-identity acceptance criterion, one step)."""
+    cfg, model, params, ids = tiny_model()
+    on = as_gencfg(cfg, use_flash_decode=True)
+    off = as_gencfg(cfg, use_flash_decode=False)
+    assert on.use_flash_decode and not off.use_flash_decode
+
+    tok = jnp.asarray(ids[:, 0])
+    outs = []
+    for gcfg in (on, off):
+        cache = init_cache(gcfg, 3, 128)
+        # Ragged frontiers incl. 0 and max_len-1: both paths read the
+        # same (zero) cache planes, so parity is deterministic.
+        cache["pos"] = jnp.asarray([0, 7, 120], jnp.int32)
+        logits, cache2 = decode_step(params, gcfg, tok, cache)
+        assert (np.asarray(cache2["pos"]) == [1, 8, 121]).all()
+        outs.append(np.asarray(logits))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=2e-4, atol=2e-4)
+    np.testing.assert_array_equal(outs[0].argmax(-1), outs[1].argmax(-1))
+
+
+def test_prefill_forward_flag_parity():
+    """Prefill (S=12, last_only) through _forward: flash on vs off."""
+    cfg, model, params, ids = tiny_model()
+    outs = []
+    for flag in (True, False):
+        gcfg = as_gencfg(cfg, use_flash_decode=flag)
+        cache = init_cache(gcfg, 3, 128)
+        logits, _ = _forward(params, gcfg, jnp.asarray(ids), cache,
+                             last_only=True)
+        outs.append(np.asarray(logits))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=2e-4, atol=2e-4)
+
+
+def test_decode_step_multiblock_env(monkeypatch):
+    """Force a multi-block split (block_k=64 over a 128 plane) through
+    the real decode-step program via the env override."""
+    cfg, model, params, ids = tiny_model()
+    tok = jnp.asarray(ids[:, 0])
+    outs = []
+    for env in ("64", None):
+        if env is None:
+            monkeypatch.delenv("DS_TPU_FLASH_DECODE_BLOCK", raising=False)
+        else:
+            monkeypatch.setenv("DS_TPU_FLASH_DECODE_BLOCK", env)
+        cache = init_cache(as_gencfg(cfg, use_flash_decode=True), 3, 128)
+        cache["pos"] = jnp.asarray([0, 65, 127], jnp.int32)
+        logits, _ = decode_step(params, as_gencfg(cfg, use_flash_decode=True),
+                                tok, cache)
+        outs.append(np.asarray(logits))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=2e-4, atol=2e-4)
+
+
+def test_generate_flag_parity_tokens_identical():
+    """Full generate() (prefill + scan) flag on vs off: greedy tokens
+    identical. Flag-on pads the cache plane to BLOCK_MIN — padding must
+    be inert."""
+    cfg, model, params, ids = tiny_model()
+    cfg_on = GPT2Config.tiny(dropout=0.0, dtype=jnp.float32,
+                             use_flash_attention=False,
+                             use_flash_decode=True)
+    out_off = np.asarray(generate(model, params, ids, 6, temperature=0.0))
+    model_on = GPT2LMHeadModel(cfg_on)
+    out_on = np.asarray(generate(model_on, params, ids, 6, temperature=0.0))
+    np.testing.assert_array_equal(out_on, out_off)

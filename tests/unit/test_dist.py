@@ -6,9 +6,10 @@ world."""
 import os
 
 import jax
+import jax.experimental.mesh_utils  # noqa: F401 (registers the attr the monkeypatch below replaces)
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from deepspeed_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deepspeed_tpu.utils import distributed as dist

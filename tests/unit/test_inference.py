@@ -265,6 +265,51 @@ def test_init_inference_facade():
     assert out[0] == seq_greedy(model, params, prompts_of(cfg, [5])[0], 4)
 
 
+# ---------------------------------------------------------- flash decode
+
+
+def test_flash_decode_engine_token_parity_and_zero_recompiles():
+    """Engine with the Pallas decode kernel engaged (interpret mode on
+    CPU): the pool plane pads to the kernel's 128 quantum, every
+    request's greedy tokens stay identical to sequential generate on the
+    einsum path, and the compile count is frozen after warmup."""
+    cfg, model, params = make_model()
+    eng = engine_of(model, params, use_flash_decode=True, max_slots=3)
+    assert eng.metrics()["flash_decode"] is True
+    # config max_len=64 -> plane padded to the kernel quantum.
+    assert eng._pool["k"].shape[3] == 128
+    lens = [5, 9, 3, 12]
+    news = [6, 3, 7, 5]
+    ps = prompts_of(cfg, lens)
+    reqs = [eng.submit(ps[i], max_new_tokens=news[i]) for i in range(2)]
+    eng.step()  # warmup: one prefill + one decode chunk
+    warm = eng.compile_count
+    assert warm == 2
+    for i in range(2, len(ps)):
+        reqs.append(eng.submit(ps[i], max_new_tokens=news[i]))
+        eng.step()
+    eng.run()
+    assert eng.compile_count == warm, \
+        "flash-decode serving recompiled after warmup ({} -> {})".format(
+            warm, eng.compile_count)
+    for req, n in zip(reqs, news):
+        assert req.tokens == seq_greedy(model, params, req.prompt, n), \
+            "flash-decode tokens diverge from the einsum path"
+    assert eng.metrics()["max_active_frontier"] == 0  # all slots drained
+
+
+def test_flash_decode_flag_resolution():
+    """config.use_flash_decode=None defers to the backend default (off
+    on CPU -> no pool padding); False forces it off even under the env
+    override."""
+    cfg, model, params = make_model()
+    eng = engine_of(model, params)  # None -> CPU default: off
+    assert eng.metrics()["flash_decode"] is False
+    assert eng._pool["k"].shape[3] == 64  # no padding on the einsum path
+    eng = engine_of(model, params, use_flash_decode=False)
+    assert eng.metrics()["flash_decode"] is False
+
+
 # ------------------------------------------------------------- tensor parallel
 
 

@@ -3,6 +3,7 @@
 LinearStackPipe vs LinearStack parity)."""
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -352,3 +353,55 @@ def test_tied_layers_share_params():
     p2 = jax.tree_util.tree_leaves(engine.layer_params[2])
     for a, b in zip(p0, p2):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class UngatedDropoutRelu(nn.Module):
+    """A stage that breaks the pipeline dropout contract: it calls
+    make_rng('dropout') WITHOUT gating on has_rng, so eval forwards (which
+    provide no dropout stream) cannot run it."""
+
+    features: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(self.features, use_bias=False)(x))
+        keep = 0.9
+        mask = jax.random.bernoulli(self.make_rng("dropout"), keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+def test_eval_batch_points_at_dropout_rng_contract():
+    """eval_batch over a layer with an ungated make_rng('dropout') must
+    fail with the convention pointer (gate on has_rng), not flax's bare
+    InvalidRngError."""
+    gas = 2
+    model = PipelineModule(
+        layers=[LayerSpec(UngatedDropoutRelu, 32), LayerSpec(DenseOut, 8)],
+        num_stages=2, loss_fn=ce_loss, seed_layers=True, base_seed=42,
+        partition_method="uniform")
+    engine, _, _, _ = deepspeed.initialize(
+        model=model,
+        config_params={
+            "train_batch_size": 8 * gas,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        })
+    data = batches(2, gas)
+    # Training provides the dropout stream — the layer is otherwise fine.
+    engine.train_batch(data_iter=iter(data[:gas]))
+    with pytest.raises(RuntimeError, match="has_rng"):
+        engine.eval_batch(data_iter=iter(data[gas:2 * gas]))
+
+
+def test_missing_dropout_rng_classifier():
+    from deepspeed_tpu.runtime.pipe.engine import _missing_dropout_rng
+    try:
+        from flax.errors import InvalidRngError
+        assert _missing_dropout_rng(
+            InvalidRngError("DenseRelu needs PRNG for \"dropout\""))
+    except ImportError:
+        pass
+    # Message-based fallback: both tokens required.
+    assert _missing_dropout_rng(Exception("rngs missing: 'dropout'"))
+    assert not _missing_dropout_rng(ValueError("dropout rate invalid"))
+    assert not _missing_dropout_rng(RuntimeError("device OOM"))
