@@ -710,20 +710,23 @@ def _decode_attention_probe(engine, reps=10):
     return (time.time() - t0) / reps * 1e3, use_flash
 
 
-def _measure_serving(smoke=False, flash_decode=None):
+def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True):
     """Continuous-batching serving benchmark (deepspeed_tpu/inference/).
 
     A synthetic Poisson request stream plays against the slotted engine:
     requests arrive at exponential inter-arrival times, admit into free
     slots at chunk boundaries, and decode concurrently. Reports tok/s,
-    p50/p99 per-token decode latency and time-to-first-token, and slot
-    occupancy; ``vs_baseline`` is the throughput ratio against serving
-    the SAME requests one at a time through models.generation.generate —
-    the continuous-batching win itself. ``smoke`` runs the tiny model
-    with a short stream (the tier-1 in-process mode). ``flash_decode``
-    forces the decode-attention path (None: the engine's default — the
-    Pallas kernel on TPU); ``--no-flash-decode`` sets False for the
-    einsum side of the kernel A/B."""
+    p50/p99 per-token decode latency, time-to-first-token and queue wait,
+    and slot occupancy; ``vs_baseline`` is the throughput ratio against
+    serving the SAME requests one at a time through
+    models.generation.generate — the continuous-batching win itself.
+    ``smoke`` runs the tiny model with a short stream (the tier-1
+    in-process mode). ``flash_decode`` forces the decode-attention path
+    (None: the engine's default — the Pallas kernel on TPU);
+    ``--no-flash-decode`` sets False for the einsum side of the kernel
+    A/B. ``chunked_prefill=False`` (``--no-chunked-prefill``) runs the
+    legacy whole-prompt-bucket prefill path — the A/B that shows chunked
+    prefill's TTFT-p99 win at equal-or-better tok/s."""
     import jax
 
     import deepspeed_tpu as deepspeed
@@ -749,6 +752,7 @@ def _measure_serving(smoke=False, flash_decode=None):
         prompt_lens, max_new = (4, 12), 8
     if flash_decode is not None:
         serve_cfg["use_flash_decode"] = flash_decode
+    serve_cfg["chunked_prefill"] = chunked_prefill
 
     model = GPT2LMHeadModel(cfg)
     rng = np.random.RandomState(0)
@@ -766,9 +770,10 @@ def _measure_serving(smoke=False, flash_decode=None):
                for n in lens]
     arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
 
-    # Warmup: one request per distinct bucket compiles every prefill
-    # program + the decode program; the timed stream then runs at the
-    # engine's zero-recompile steady state.
+    # Warmup: chunked prefill compiles its ONE mixed-step program on the
+    # first request; the legacy path needs one request per distinct
+    # bucket to compile every prefill program + the decode program. The
+    # timed stream then runs at the engine's zero-recompile steady state.
     engine.generate([prompts[lens.index(n)] for n in sorted(set(lens))],
                     max_new_tokens=2)
     warm_compiles = engine.compile_count
@@ -828,6 +833,8 @@ def _measure_serving(smoke=False, flash_decode=None):
         # A/B runs must not share last-good bookkeeping with the default
         # (kernel-on) metric series.
         name += "_noflashdecode"
+    if not chunked_prefill:
+        name += "_nochunkedprefill"
     return {
         "metric": name,
         "value": round(tok_per_sec, 1),
@@ -845,12 +852,16 @@ def _measure_serving(smoke=False, flash_decode=None):
                 float(np.percentile(per_tok, 99)) * 1e3, 3),
             "p50_ttft_ms": round(float(np.percentile(ttft, 50)) * 1e3, 3),
             "p99_ttft_ms": round(float(np.percentile(ttft, 99)) * 1e3, 3),
+            "p50_queue_wait_ms": m["queue_wait_p50_ms"],
+            "p99_queue_wait_ms": m["queue_wait_p99_ms"],
             "slot_occupancy": round(m["slot_occupancy"], 4),
             "sequential_tokens_per_sec": round(seq_tok_per_sec, 1),
             "compile_count": m["compile_count"],
             "recompiles_after_warmup": m["compile_count"] - warm_compiles,
             "max_slots": serve_cfg["max_slots"],
             "chunk_size": serve_cfg["chunk_size"],
+            "chunked_prefill": chunked_prefill,
+            "prefill_chunk": m["prefill_chunk"] if chunked_prefill else None,
             "flash_decode": engaged,
             "decode_block_k": block_k,
             "kv_plane_len": plane_len,
@@ -862,10 +873,11 @@ def _measure_serving(smoke=False, flash_decode=None):
     }
 
 
-def main_serve(smoke=False, flash_decode=None):
+def main_serve(smoke=False, flash_decode=None, chunked_prefill=True):
     if not smoke:
         _require_tpu_or_exit()
-    _emit(_measure_serving(smoke=smoke, flash_decode=flash_decode))
+    _emit(_measure_serving(smoke=smoke, flash_decode=flash_decode,
+                           chunked_prefill=chunked_prefill))
     return 0
 
 
@@ -905,11 +917,16 @@ def main_sweep():
 def _dispatch(argv):
     # --no-flash-decode: the einsum side of the decode-kernel A/B
     # (default None lets the engine pick — the Pallas kernel on TPU).
+    # --no-chunked-prefill: the legacy whole-prompt-bucket prefill side
+    # of the chunked-prefill A/B (default True — the fused mixed step).
     flash_decode = False if "--no-flash-decode" in argv else None
+    chunked = "--no-chunked-prefill" not in argv
     if "--serve-smoke" in argv:
-        return main_serve(smoke=True, flash_decode=flash_decode)
+        return main_serve(smoke=True, flash_decode=flash_decode,
+                          chunked_prefill=chunked)
     if "--serve" in argv:
-        return main_serve(flash_decode=flash_decode)
+        return main_serve(flash_decode=flash_decode,
+                          chunked_prefill=chunked)
     if "--sweep" in argv:
         return main_sweep()
     if "--xl-compute" in argv:

@@ -8,7 +8,9 @@ against the model's position budget at engine construction.
 Every field is a COMPILE-SHAPE knob or a host-side policy knob — nothing
 here varies per request (per-request sampling params travel as traced
 device values, see engine.py), which is what bounds the compile count:
-one prefill program per prompt bucket + one decode-chunk program, total.
+ONE mixed-step program under chunked prefill (the default), or one
+prefill program per prompt bucket + one decode-chunk program on the
+legacy path (``chunked_prefill=False``).
 """
 
 import dataclasses
@@ -25,6 +27,8 @@ INFERENCE_DEFAULTS = {
     "eos_token_id": None,
     "max_new_tokens": 128,
     "use_flash_decode": None,
+    "chunked_prefill": True,
+    "prefill_chunk": 32,
 }
 
 
@@ -54,7 +58,9 @@ class InferenceConfig:
     # dispatch, smaller chunks cut admission latency.
     chunk_size: int = 16
     # Prompt-length buckets for prefill padding (sorted ascending). None
-    # derives power-of-two buckets from max_len.
+    # derives power-of-two buckets from max_len. LEGACY-path only: under
+    # chunked_prefill there is no whole-prompt program to pad for and the
+    # table is inert.
     prefill_buckets: Optional[Tuple[int, ...]] = None
     # Queued (not yet admitted) request cap — submit() raises QueueFull
     # beyond it. The backpressure boundary for upstream callers.
@@ -72,6 +78,19 @@ class InferenceConfig:
     # 128-position block quantum (admission limits still enforce the
     # configured max_len).
     use_flash_decode: Optional[bool] = None
+    # Chunked prefill (Sarathi-style): prompts are consumed
+    # ``prefill_chunk`` tokens at a time INSIDE the decode step program —
+    # one mixed-batch program total, no per-bucket prefill compiles, no
+    # decode stall while a long prompt admits. False restores the legacy
+    # whole-prompt-per-bucket prefill path (the ``prefill_buckets`` table
+    # only applies there).
+    chunked_prefill: bool = True
+    # Prompt tokens consumed per engine step while a slot is prefilling.
+    # Larger chunks finish prefill in fewer steps (better TTFT for the
+    # prefilling request); smaller chunks bound the extra latency each
+    # step adds for already-decoding slots. Also the KV plane slack the
+    # pool over-allocates so frontier writes never clamp.
+    prefill_chunk: int = 32
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -83,6 +102,9 @@ class InferenceConfig:
         if self.max_queue < 1:
             raise ValueError("inference.max_queue must be >= 1, got "
                              "{}".format(self.max_queue))
+        if self.prefill_chunk < 1:
+            raise ValueError("inference.prefill_chunk must be >= 1, got "
+                             "{}".format(self.prefill_chunk))
         buckets = self.prefill_buckets
         if buckets is None:
             buckets = default_buckets(self.max_len)

@@ -1,30 +1,39 @@
 """InferenceEngine — continuous-batching serving over the slotted KV pool.
 
-Two jitted programs serve every request mix (the compile-count contract
-docs/INFERENCE.md pins and tests/unit/test_inference.py asserts):
+Chunked prefill (the default, Sarathi-Serve-style — Agrawal et al.,
+OSDI'24) serves every request mix with ONE jitted program:
 
-- PREFILL (one compile per prompt bucket): slice one slot's k/v planes
-  out of the pool, run the batched prompt pass (``models.generation``'s
-  ``_forward`` — MXU-sized GEMMs over the padded bucket), write the slot
-  back, sample the first token, and install the request's per-slot state.
-  The slot index, true prompt length and sampling params are all TRACED,
-  so any request lands in any slot under the same program.
+- MIXED STEP (one compile, ever): a PREFILL LANE appends one
+  ``prefill_chunk``-token slice of ONE slot's prompt at its cursor
+  (``models.generation.append_forward`` — causal against the slot's
+  existing cache, k/v written at a TRACED frontier), sampling the
+  request's first token when the slice is the prompt's last; then the
+  DECODE LANE advances ALL slots ``chunk_size`` tokens via one
+  ``lax.scan`` over ``models.generation.decode_step``. Slot index,
+  cursor, slice length and every sampling param are traced, so any
+  prompt-length mix runs the same program — no per-bucket compiles, and
+  decode never stalls behind a long prompt (bounded TTFT instead of
+  head-of-line blocking).
 
-- DECODE CHUNK (one compile, ever): advance ALL slots ``chunk_size``
-  tokens via one ``lax.scan`` over ``models.generation.decode_step``.
-  Inactive slots are frozen — their pos is pinned and emissions masked —
-  exactly the trick ``generate`` uses for early-EOS rows, so occupancy
-  changes never change the program.
+``chunked_prefill=False`` restores the legacy pair — PREFILL (one
+compile per prompt bucket: whole prompt at batch dim 1, decode stalled
+while it runs) + DECODE CHUNK — for A/B runs (`bench.py --serve
+--no-chunked-prefill`).
 
-The host loop (``step()``) runs the Orca cycle at chunk boundaries:
-admit queued requests into free slots (prefill), decode one chunk,
-harvest emitted tokens, evict finished slots. Under greedy decoding the
-emitted tokens are token-identical to sequential ``generate`` calls —
-both drive the same decode step program (models/generation.py).
+Inactive slots are frozen in every program — pos pinned, emissions
+masked — exactly the trick ``generate`` uses for early-EOS rows, so
+occupancy changes never change a program.
+
+The host loop (``step()``) runs the Orca cycle at step boundaries:
+admit queued requests into free slots, feed the oldest prefilling
+slot's next prompt chunk, decode, harvest emitted tokens in ONE batched
+host sync, evict finished slots. Under greedy decoding the emitted
+tokens are token-identical to sequential ``generate`` calls — all paths
+drive the same decode step program (models/generation.py).
 
 Tensor parallelism: pass a mesh with a 'model' axis — params shard by
 DEFAULT_TP_RULES (parallel/mesh.py), the KV pool shards its heads dim to
-match, and both programs pin their out_shardings so the cache layout
+match, and every program pins its out_shardings so the cache layout
 survives every step. One engine, sharded or not.
 """
 
@@ -65,19 +74,36 @@ def _sample_rows(logits, temp, top_k, seed, position):
     greedy and bit-identical to ``generate``'s argmax; top_k<=0 disables
     the top-k filter. The rng is derived as fold_in(PRNGKey(seed), pos):
     a (request seed, token position) pair names each draw, independent of
-    slot placement or chunk boundaries."""
+    slot placement or chunk boundaries.
+
+    Fast path: the params are traced, so whether ANY row actually needs
+    the [R, V] sort (top-k) or a categorical draw is a runtime fact —
+    both sit behind ``lax.cond`` so pure-greedy serving (the common
+    case) pays only the argmax, with zero recompiles when a sampled
+    request later joins the batch."""
     V = logits.shape[-1]
-    greedy = jnp.argmax(logits, axis=-1)
-    # kth-largest per row with a TRACED k: sort once, gather the kth.
-    srt = jnp.sort(logits, axis=-1)[:, ::-1]
-    kth = jnp.take_along_axis(
-        srt, jnp.clip(top_k - 1, 0, V - 1)[:, None], axis=1)
-    masked = jnp.where((top_k[:, None] > 0) & (logits < kth), _neg(), logits)
-    scaled = masked / jnp.maximum(temp, 1e-6)[:, None]
-    keys = jax.vmap(lambda s, p: jax.random.fold_in(
-        jax.random.PRNGKey(s), p))(seed, position)
-    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
-    return jnp.where(temp > 0.0, sampled, greedy).astype(jnp.int32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _topk_filter(l):
+        # kth-largest per row with a TRACED k: sort once, gather the kth.
+        srt = jnp.sort(l, axis=-1)[:, ::-1]
+        kth = jnp.take_along_axis(
+            srt, jnp.clip(top_k - 1, 0, V - 1)[:, None], axis=1)
+        return jnp.where((top_k[:, None] > 0) & (l < kth), _neg(), l)
+
+    masked = jax.lax.cond(jnp.any(top_k > 0), _topk_filter,
+                          lambda l: l, logits)
+
+    def _draw(m):
+        scaled = m / jnp.maximum(temp, 1e-6)[:, None]
+        keys = jax.vmap(lambda s, p: jax.random.fold_in(
+            jax.random.PRNGKey(s), p))(seed, position)
+        return jax.vmap(jax.random.categorical)(keys, scaled).astype(
+            jnp.int32)
+
+    sampled = jax.lax.cond(jnp.any(temp > 0.0), _draw,
+                           lambda m: greedy, masked)
+    return jnp.where(temp > 0.0, sampled, greedy)
 
 
 # --------------------------------------------------------------- programs
@@ -88,9 +114,10 @@ def _sample_rows(logits, temp, top_k, seed, position):
 
 def _prefill_program(params, gcfg, pool, prompt, prompt_len, slot,
                      max_new, eos_id, temp, top_k, seed):
-    """Admit one request into ``slot``. ``prompt`` is [1, bucket] (padded
-    right; pad ids are arbitrary — their logits are never read and their
-    k/v writes sit beyond the frontier). Returns (pool', first_token)."""
+    """LEGACY path: admit one request into ``slot`` with a whole-prompt
+    pass. ``prompt`` is [1, bucket] (padded right; pad ids are arbitrary
+    — their logits are never read and their k/v writes sit beyond the
+    frontier). Returns (pool', first_token)."""
     ks = jax.lax.dynamic_slice_in_dim(pool["k"], slot, 1, axis=1)
     vs = jax.lax.dynamic_slice_in_dim(pool["v"], slot, 1, axis=1)
     cache = {"k": ks, "v": vs, "pos": jnp.zeros((1,), jnp.int32)}
@@ -146,6 +173,68 @@ def _decode_chunk_program(params, gcfg, chunk, pool):
     return pool, toks, valid
 
 
+def _mixed_step_program(params, gcfg, chunk, pool, p_ids, p_slot,
+                        p_frontier, p_valid, p_done, p_max_new, p_eos,
+                        p_temp, p_top_k, p_seed):
+    """One fused serving step — THE chunked-prefill program.
+
+    PREFILL LANE: append ``p_ids`` [1, C] (``p_valid`` leading columns
+    real) into slot ``p_slot``'s planes at frontier ``p_frontier``. When
+    ``p_done`` marks the prompt's final slice, sample the first token
+    and install the request's per-slot state (it starts decoding in
+    THIS step's decode lane — the same cadence as the legacy
+    admit-then-decode step). ``p_valid == 0`` means no prefill work and
+    the whole lane is skipped by ``lax.cond`` — an idle lane costs no
+    FLOPs, so pure-decode steady state is unchanged.
+
+    DECODE LANE: the same scan as ``_decode_chunk_program``.
+
+    Everything per-request is traced; ``chunk`` and the [1, C] slice
+    shape are the only static facts — ONE compile serves every
+    prompt-length mix, which is the whole compile-count contract.
+
+    Returns (pool', first_token, tokens [chunk, slots], valid): the
+    first token is -1 unless ``p_done``.
+    """
+    C = p_ids.shape[1]
+
+    def _lane(pool):
+        ks = jax.lax.dynamic_slice_in_dim(pool["k"], p_slot, 1, axis=1)
+        vs = jax.lax.dynamic_slice_in_dim(pool["v"], p_slot, 1, axis=1)
+        cache = {"k": ks, "v": vs, "pos": p_frontier[None]}
+        logits, cache = generation.append_forward(
+            params, gcfg, p_ids, cache, n_valid=p_valid[None])
+        # The prompt's true last row (garbage pad rows sit past it).
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], jnp.clip(p_valid - 1, 0, C - 1), keepdims=False)
+        first = _sample_rows(last[None], p_temp[None], p_top_k[None],
+                             p_seed[None], (p_frontier + p_valid)[None])[0]
+        pool = dict(pool)
+        pool["k"] = jax.lax.dynamic_update_slice_in_dim(
+            pool["k"], cache["k"], p_slot, axis=1)
+        pool["v"] = jax.lax.dynamic_update_slice_in_dim(
+            pool["v"], cache["v"], p_slot, axis=1)
+        # Mid-prefill slices only move the frontier; the final slice
+        # installs the full decode state (same fields as the legacy
+        # prefill). First token counts against the budget; a request can
+        # finish at admission (max_new==1, or its first token IS EOS).
+        finished = (p_max_new <= 1) | ((p_eos >= 0) & (first == p_eos))
+        for name, val in (("last_tok", first),
+                          ("active", p_done & ~finished),
+                          ("remaining", p_max_new - 1), ("eos", p_eos),
+                          ("temp", p_temp), ("top_k", p_top_k),
+                          ("seed", p_seed)):
+            pool[name] = pool[name].at[p_slot].set(
+                jnp.where(p_done, val, pool[name][p_slot]))
+        pool["pos"] = pool["pos"].at[p_slot].set(p_frontier + p_valid)
+        return pool, jnp.where(p_done, first, jnp.int32(-1))
+
+    pool, first = jax.lax.cond(
+        p_valid > 0, _lane, lambda pool: (pool, jnp.int32(-1)), pool)
+    pool, toks, valid = _decode_chunk_program(params, gcfg, chunk, pool)
+    return pool, first, toks, valid
+
+
 class InferenceEngine(object):
     """Continuous-batching serving engine (see module docstring).
 
@@ -172,7 +261,12 @@ class InferenceEngine(object):
         self.mesh = mesh
         self._scheduler = Scheduler(config.max_slots, config.max_queue)
 
-        pool = init_pool(self._gcfg, config.max_slots, config.max_len)
+        # Chunked prefill appends up to prefill_chunk positions at a
+        # frontier that can sit as deep as max_len-1 — the plane carries
+        # that much slack so the write never clamps (kv_pool docstring).
+        slack = config.prefill_chunk if config.chunked_prefill else 0
+        pool = init_pool(self._gcfg, config.max_slots, config.max_len,
+                         slack=slack)
         if mesh is not None and mesh_lib.mp_size(mesh) > 1:
             param_sh, _, _ = mesh_lib.zero_shardings(mesh, params, stage=0)
             params = jax.tree_util.tree_map(jax.device_put, params, param_sh)
@@ -181,8 +275,9 @@ class InferenceEngine(object):
             rep = mesh_lib.replicated(mesh)
             prefill_out = (pool_out, rep)
             decode_out = (pool_out, rep, rep)
+            mixed_out = (pool_out, rep, rep, rep)
         else:
-            prefill_out = decode_out = None
+            prefill_out = decode_out = mixed_out = None
         self._params = params
         self._pool = pool
 
@@ -193,19 +288,24 @@ class InferenceEngine(object):
         # engines jitting the bare program would pool their cache entries
         # and the counter would read other engines' compiles. Donating
         # the pool threads one cache allocation through every program
-        # call instead of double-buffering gigabytes of k/v.
+        # call instead of double-buffering gigabytes of k/v. All three
+        # wrappers exist on every engine (trace-free until called);
+        # chunked mode only ever calls _mixed, legacy only the other two.
         self._prefill = jax.jit(
             functools.partial(_prefill_program), static_argnums=(1,),
             donate_argnums=(2,), out_shardings=prefill_out)
         self._decode = jax.jit(
             functools.partial(_decode_chunk_program), static_argnums=(1, 2),
             donate_argnums=(3,), out_shardings=decode_out)
+        self._mixed = jax.jit(
+            functools.partial(_mixed_step_program), static_argnums=(1, 2),
+            donate_argnums=(3,), out_shardings=mixed_out)
 
         self.timers = SynchronizedWallClockTimer()
         self.counters = {
             "tokens_out": 0, "chunks": 0, "prefills": 0,
-            "requests_completed": 0, "occupied_slot_steps": 0,
-            "slot_steps": 0,
+            "prefill_tokens": 0, "requests_completed": 0,
+            "occupied_slot_steps": 0, "slot_steps": 0,
         }
         self._t0 = time.time()
 
@@ -224,7 +324,8 @@ class InferenceEngine(object):
             max_new_tokens = self.config.max_new_tokens
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        self.config.bucket_for(prompt.size)  # raises when over-long
+        if not self.config.chunked_prefill:
+            self.config.bucket_for(prompt.size)  # raises when over-long
         if prompt.size + max_new_tokens > self.config.max_len:
             raise ValueError(
                 "prompt ({} tokens) + max_new_tokens ({}) exceeds "
@@ -237,41 +338,134 @@ class InferenceEngine(object):
             int(top_k or 0), -1 if eos_token_id is None else int(eos_token_id),
             int(seed))
 
-    # -------------------------------------------------------------- admit
+    # ------------------------------------------------------------- cancel
 
-    def _admit(self, req, slot):
+    def cancel(self, req):
+        """Evict ``req`` wherever it lives — queued, MID-PREFILL, or
+        decoding. Frees its slot for the next admission round; tokens
+        emitted so far stay on the request. Returns False when it had
+        already finished."""
+        was_decoding = req.phase == "decoding" and req.slot is not None
+        slot = req.slot
+        if not self._scheduler.cancel(req):
+            return False
+        if was_decoding:
+            # Freeze the slot on device so the decode lane stops burning
+            # its rows (a prefilling slot was never active — nothing to
+            # clear; its frontier is overwritten at re-admission).
+            self._pool = dict(self._pool, active=self._pool["active"]
+                              .at[slot].set(False))
+        return True
+
+    # ----------------------------------------------------- legacy admit
+
+    def _dispatch_prefill(self, req, slot):
+        """Dispatch one legacy whole-prompt prefill; returns the first
+        token as a DEVICE value — the host sync happens batched in
+        step() after every admission has been dispatched."""
         bucket = self.config.bucket_for(req.prompt.size)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :req.prompt.size] = req.prompt
-        self.timers("inference/prefill").start()
         self._pool, first = self._prefill(
             self._params, self._gcfg, self._pool, jnp.asarray(padded),
             jnp.int32(req.prompt.size), jnp.int32(slot),
             jnp.int32(req.max_new_tokens), jnp.int32(req.eos_token_id),
             jnp.float32(req.temperature), jnp.int32(req.top_k),
             jnp.uint32(req.seed))
-        self.timers("inference/prefill").stop()
         self.counters["prefills"] += 1
-        first = int(first)
+        self.counters["prefill_tokens"] += int(req.prompt.size)
+        return first
+
+    def _harvest_first(self, req, first, done):
+        """Record a request's first token (TTFT stamps HERE — at
+        harvest, after the device sync — never at dispatch)."""
         req.tokens.append(first)
         req.first_token_time = time.time()
         self.counters["tokens_out"] += 1
         if req.max_new_tokens <= 1 or \
                 (req.eos_token_id >= 0 and first == req.eos_token_id):
-            self._scheduler.complete(slot)
+            self._scheduler.complete(req.slot)
             self.counters["requests_completed"] += 1
+            done.append(req)
 
     # --------------------------------------------------------------- step
 
     def step(self):
-        """One chunk boundary: admit into free slots, decode one chunk,
-        harvest tokens, evict finished slots. Returns the requests
-        completed during this step."""
+        """One step boundary: admit into free slots, advance prefill and
+        decode, harvest tokens, evict finished slots. Returns the
+        requests completed during this step."""
+        if self.config.chunked_prefill:
+            return self._step_chunked()
+        return self._step_legacy()
+
+    def _step_chunked(self):
         done = []
-        for req, slot in self._scheduler.admissions():
-            self._admit(req, slot)
-            if req.done:
+        self._scheduler.admissions()
+        pf = self._scheduler.next_prefill()
+        C = self.config.prefill_chunk
+        ids = np.zeros((1, C), np.int32)
+        if pf is not None:
+            cur = pf.cursor
+            n = int(min(C, pf.prompt.size - cur))
+            ids[0, :n] = pf.prompt[cur:cur + n]
+            slot, frontier, n_valid = pf.slot, cur, n
+            p_done = cur + n >= pf.prompt.size
+            max_new, eos = pf.max_new_tokens, pf.eos_token_id
+            temp, top_k, seed = pf.temperature, pf.top_k, pf.seed
+        else:
+            # Idle lane: p_valid == 0 short-circuits it inside the
+            # program (lax.cond) — the remaining args are inert.
+            slot = frontier = n_valid = 0
+            p_done, max_new, eos, temp, top_k, seed = False, 1, -1, 0.0, 0, 0
+
+        self.timers("inference/decode").start()
+        self._pool, first, toks, valid = self._mixed(
+            self._params, self._gcfg, self.config.chunk_size, self._pool,
+            jnp.asarray(ids), jnp.int32(slot), jnp.int32(frontier),
+            jnp.int32(n_valid), jnp.asarray(p_done), jnp.int32(max_new),
+            jnp.int32(eos), jnp.float32(temp), jnp.int32(top_k),
+            jnp.uint32(seed))
+        # ONE batched host sync per step: tokens, validity, occupancy and
+        # the (possible) first token all land together.
+        toks = np.asarray(toks)
+        valid = np.asarray(valid)
+        active = np.asarray(self._pool["active"])
+        self.timers("inference/decode").stop()
+        self.counters["chunks"] += 1
+        self.counters["occupied_slot_steps"] += int(valid.sum())
+        self.counters["slot_steps"] += valid.size
+
+        if pf is not None:
+            self.counters["prefill_tokens"] += n_valid
+            if self._scheduler.advance_prefill(pf, n_valid):
+                self.counters["prefills"] += 1
+                self._harvest_first(pf, int(first), done)
+
+        for slot, req in list(self._scheduler.running.items()):
+            if req.phase != "decoding":
+                continue  # mid-prefill slots emit nothing
+            emitted = toks[valid[:, slot], slot].tolist()
+            req.tokens.extend(emitted)
+            self.counters["tokens_out"] += len(emitted)
+            if not active[slot]:
+                self._scheduler.complete(slot)
+                self.counters["requests_completed"] += 1
                 done.append(req)
+        return done
+
+    def _step_legacy(self):
+        done = []
+        admitted = []
+        self.timers("inference/prefill").start()
+        for req, slot in self._scheduler.admissions():
+            # Dispatch EVERY prefill before the first host sync: N
+            # admissions pipeline on device instead of paying N
+            # dispatch->int(first) round-trips.
+            admitted.append((req, self._dispatch_prefill(req, slot)))
+        for req, first in admitted:
+            self._scheduler.advance_prefill(req, req.prompt.size)
+            self._harvest_first(req, int(first), done)
+        self.timers("inference/prefill").stop()
 
         if self._scheduler.running:
             self.timers("inference/decode").start()
@@ -321,17 +515,50 @@ class InferenceEngine(object):
 
     @property
     def compile_count(self):
-        """Total compiled program count across prefill + decode — the
-        number the zero-recompile-after-warmup guarantee is asserted on."""
-        return self._prefill._cache_size() + self._decode._cache_size()
+        """Total compiled program count across every engine program — the
+        number the zero-recompile-after-warmup guarantee is asserted on.
+        Chunked prefill: 1 after warmup (the mixed step), whatever the
+        prompt-length mix. Legacy: 1 decode chunk + one prefill per
+        prompt bucket exercised."""
+        return (self._prefill._cache_size() + self._decode._cache_size() +
+                self._mixed._cache_size())
+
+    def _latency_percentiles(self):
+        """TTFT / inter-token / queue-wait percentiles over COMPLETED
+        requests (milliseconds; None before the first completion). The
+        timestamps are the scheduler's: submit -> admit (queue wait),
+        submit -> first harvested token (TTFT), then (finish - first) /
+        (tokens - 1) as the mean inter-token gap per request."""
+        ttft, qwait, itl = [], [], []
+        for r in self._scheduler.completed.values():
+            if r.admit_time is not None:
+                qwait.append(r.admit_time - r.submit_time)
+            if r.first_token_time is not None:
+                ttft.append(r.first_token_time - r.submit_time)
+                if r.finish_time is not None and len(r.tokens) > 1:
+                    itl.append((r.finish_time - r.first_token_time) /
+                               (len(r.tokens) - 1))
+
+        def pct(xs, p):
+            return round(float(np.percentile(xs, p)) * 1e3, 3) if xs else None
+
+        return {
+            "ttft_p50_ms": pct(ttft, 50),
+            "ttft_p99_ms": pct(ttft, 99),
+            "inter_token_p50_ms": pct(itl, 50),
+            "inter_token_p99_ms": pct(itl, 99),
+            "queue_wait_p50_ms": pct(qwait, 50),
+            "queue_wait_p99_ms": pct(qwait, 99),
+        }
 
     def metrics(self):
         wall = max(time.time() - self._t0, 1e-9)
         c = self.counters
-        return {
+        m = {
             "tokens_out": c["tokens_out"],
             "requests_completed": c["requests_completed"],
             "prefills": c["prefills"],
+            "prefill_tokens": c["prefill_tokens"],
             "chunks": c["chunks"],
             "tokens_per_sec": c["tokens_out"] / wall,
             "slot_occupancy": (c["occupied_slot_steps"] /
@@ -344,5 +571,9 @@ class InferenceEngine(object):
             "decode_seconds": self.timers(
                 "inference/decode").elapsed(reset=False),
             "flash_decode": bool(self._gcfg.use_flash_decode),
+            "chunked_prefill": bool(self.config.chunked_prefill),
+            "prefill_chunk": self.config.prefill_chunk,
             "max_active_frontier": max_active_frontier(self._pool),
         }
+        m.update(self._latency_percentiles())
+        return m

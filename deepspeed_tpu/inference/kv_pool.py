@@ -39,6 +39,11 @@ Layout invariants the flash-decode kernel
   padding is inert because admission still enforces the CONFIGURED
   ``max_len`` (``prompt + max_new_tokens <= max_len``), so no frontier
   ever reaches a padded position and the mask excludes them all;
+- under chunked prefill the plane carries ``prefill_chunk`` extra SLACK
+  positions past ``max_len`` (then block-quantum padding on top), so an
+  append's multi-position frontier write stays in bounds for every
+  admissible frontier — slack positions are masked exactly like quantum
+  padding, never attended;
 - ``pos[b]`` is the PRE-write frontier: positions ``0..pos[b]-1`` hold
   the row's valid k/v, everything at ``>= pos[b] + S`` (after a write of
   S new positions) is zeros or a stale request's data. The kernel's
@@ -70,23 +75,28 @@ _SLOT_FIELDS = (
 )
 
 
-def plane_len_for(gcfg, max_len):
+def plane_len_for(gcfg, max_len, slack=0):
     """Cache-plane length serving ``max_len`` positions under ``gcfg``:
     padded up to the flash-decode block quantum when the kernel serves
     the pool (see module docstring — padding is inert), ``max_len``
-    as-is otherwise."""
+    as-is otherwise. ``slack`` adds inert positions past the last
+    admissible frontier — chunked prefill needs ``prefill_chunk`` of
+    them so an append's S-position frontier write NEVER clamps
+    (``dynamic_update_slice`` clamps a start index whose window would
+    run off the plane, which would silently shift the write onto live
+    positions)."""
     if getattr(gcfg, "use_flash_decode", False):
-        return decode_attention.pad_cache_len(max_len)
-    return max_len
+        return decode_attention.pad_cache_len(max_len + slack)
+    return max_len + slack
 
 
-def init_pool(gcfg, num_slots, max_len, dtype=None):
+def init_pool(gcfg, num_slots, max_len, dtype=None, slack=0):
     """Zeroed pool pytree for ``num_slots`` sequences of up to ``max_len``
     positions under generation config ``gcfg`` (models.generation.as_gencfg).
-    The allocated plane length is ``plane_len_for(gcfg, max_len)``."""
+    The allocated plane length is ``plane_len_for(gcfg, max_len, slack)``."""
     dtype = dtype or gcfg.dtype
     hd = gcfg.n_embd // gcfg.n_head
-    plane_len = plane_len_for(gcfg, max_len)
+    plane_len = plane_len_for(gcfg, max_len, slack)
     if getattr(gcfg, "use_flash_decode", False):
         assert decode_attention.decode_supported(plane_len), plane_len
     kv_shape = (gcfg.n_layer, num_slots, gcfg.n_head, plane_len, hd)

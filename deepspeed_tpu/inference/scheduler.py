@@ -2,14 +2,21 @@
 
 The device side (kv_pool / engine programs) is shape-static; ALL dynamic
 serving behavior lives here: a bounded FIFO queue, admission of queued
-requests into free slots at chunk boundaries, eviction of finished slots,
-and completion bookkeeping. Orca-style iteration-level scheduling
-(Yu et al., OSDI'22) degenerates to exactly this once the batch is a
-fixed slot set: the only decisions left are "which queued request takes
-which free slot" (FIFO) and "when" (every chunk boundary).
+requests into free slots at step boundaries, a PREFILLING phase that
+walks a cursor through the prompt ``prefill_chunk`` tokens at a time
+(Sarathi-style chunked prefill — Agrawal et al., OSDI'24), eviction of
+finished slots, and completion bookkeeping. Orca-style iteration-level
+scheduling (Yu et al., OSDI'22) degenerates to exactly this once the
+batch is a fixed slot set: the only decisions left are "which queued
+request takes which free slot" (FIFO), "whose prompt chunk rides the
+next step" (FIFO among prefilling slots), and "when" (every step).
 
-Timestamps are stamped here (submit / first token / finish) so the
-serving benchmark and the engine's metrics read one source of truth.
+Request phases: ``queued -> prefilling -> decoding -> done`` (or
+``cancelled`` from any live phase). The legacy whole-prompt prefill
+path passes through ``prefilling`` for exactly one engine step.
+
+Timestamps are stamped here (submit / admit / first token / finish) so
+the serving benchmark and the engine's metrics read one source of truth.
 """
 
 import collections
@@ -26,8 +33,9 @@ class Request(object):
     """One generation request and its accumulated output."""
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature", "top_k",
-                 "eos_token_id", "seed", "tokens", "slot",
-                 "submit_time", "first_token_time", "finish_time")
+                 "eos_token_id", "seed", "tokens", "slot", "phase", "cursor",
+                 "submit_time", "admit_time", "first_token_time",
+                 "finish_time")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature, top_k,
                  eos_token_id, seed):
@@ -40,7 +48,12 @@ class Request(object):
         self.seed = seed
         self.tokens = []
         self.slot = None
+        self.phase = "queued"
+        # Prompt tokens consumed so far (chunked prefill walks this to
+        # len(prompt); the legacy path jumps it there in one step).
+        self.cursor = 0
         self.submit_time = time.time()
+        self.admit_time = None
         self.first_token_time = None
         self.finish_time = None
 
@@ -56,8 +69,8 @@ class Scheduler(object):
         self.num_slots = num_slots
         self.max_queue = max_queue
         self.queue = collections.deque()
-        self.running = {}           # slot -> Request
-        self.completed = {}         # rid -> Request
+        self.running = {}           # slot -> Request (prefilling | decoding)
+        self.completed = {}         # rid -> Request (incl. cancelled)
         self._ids = itertools.count()
 
     # ------------------------------------------------------------ submit
@@ -80,17 +93,42 @@ class Scheduler(object):
 
     def admissions(self):
         """FIFO: pop (request, slot) pairs for every free slot while the
-        queue lasts. Called by the engine ONLY at chunk boundaries — the
-        decode program never sees a mid-chunk batch change."""
+        queue lasts, moving each request into the ``prefilling`` phase
+        (admit_time stamped — queue-wait ends here). Called by the
+        engine ONLY at step boundaries — the device programs never see a
+        mid-step batch change."""
         pairs = []
         for slot in self.free_slot_ids():
             if not self.queue:
                 break
             req = self.queue.popleft()
             req.slot = slot
+            req.phase = "prefilling"
+            req.cursor = 0
+            req.admit_time = time.time()
             self.running[slot] = req
             pairs.append((req, slot))
         return pairs
+
+    # ----------------------------------------------------------- prefill
+
+    def next_prefill(self):
+        """The prefilling request whose next prompt chunk rides the
+        coming step: FIFO by admission order (admission is FIFO over a
+        FIFO queue, so rid order IS admission order). None when no slot
+        is mid-prefill."""
+        pf = [r for r in self.running.values() if r.phase == "prefilling"]
+        return min(pf, key=lambda r: r.rid) if pf else None
+
+    def advance_prefill(self, req, n):
+        """Record ``n`` prompt tokens consumed; returns True when the
+        prompt is exhausted (the request's first token was sampled this
+        step and it moves to ``decoding``)."""
+        req.cursor += n
+        if req.cursor >= req.prompt.size:
+            req.phase = "decoding"
+            return True
+        return False
 
     # -------------------------------------------------------- completion
 
@@ -99,9 +137,30 @@ class Scheduler(object):
         the next admission round."""
         req = self.running.pop(slot)
         req.finish_time = time.time()
+        req.phase = "done"
         req.slot = None
         self.completed[req.rid] = req
         return req
+
+    def cancel(self, req):
+        """Evict ``req`` wherever it lives — queued, mid-prefill, or
+        decoding. Its slot (if any) frees for the next admission round;
+        tokens emitted so far stay on the request. Returns True when the
+        request was live (False: already finished). The caller owns any
+        device-side deactivation (the engine clears the slot's active
+        flag for decoding-phase cancels; a prefilling slot has no device
+        state to clear — its frontier is overwritten at re-admission)."""
+        if req.done:
+            return False
+        if req.phase == "queued":
+            self.queue.remove(req)
+        else:
+            self.running.pop(req.slot)
+            req.slot = None
+        req.phase = "cancelled"
+        req.finish_time = time.time()
+        self.completed[req.rid] = req
+        return True
 
     @property
     def idle(self):

@@ -175,6 +175,29 @@ def _forward(params, cfg, ids, cache, last_only=False):
     return logits, {"k": k_cache, "v": v_cache, "pos": pos + S}
 
 
+def append_forward(params, cfg, ids, cache, n_valid=None):
+    """Append ``ids`` [B, S] at each row's frontier ``cache['pos']`` —
+    the chunked-prefill primitive: one prompt slice per call, causally
+    masked against everything already in the cache (the same per-row
+    global-position mask decode uses), k/v written in place at the
+    frontier. Returns (fp32 logits [B, S, V], advanced cache).
+
+    ``n_valid`` [B] (default: all S) marks how many LEADING columns per
+    row are real tokens; the frontier advances by ``n_valid``, not S.
+    Pad columns still write k/v — but at positions >= the advanced
+    frontier, where the causal mask hides them until the next append or
+    decode write lands on top (the KV pool's stale-cache rule). Their
+    logits are garbage the caller must ignore. The cache plane must
+    leave S positions of slack past the last admissible frontier so the
+    frontier write never clamps (inference/kv_pool.py over-allocates by
+    ``prefill_chunk``)."""
+    pos0 = cache["pos"]
+    logits, cache = _forward(params, cfg, ids, cache)
+    if n_valid is not None:
+        cache = dict(cache, pos=pos0 + n_valid)
+    return logits, cache
+
+
 def decode_step(params, cfg, tok, cache):
     """Advance every row one token: feed ``tok`` [B] (the token sitting at
     each row's frontier ``cache['pos']``), write its k/v there, and return

@@ -18,7 +18,10 @@ trace), then copies the winners from the user cache into the bundled
 table, schema-validating the result before writing.
 
 Usage: python tests/perf/autotune_sweep.py
-           [--shapes b8t1024,b4t2048,...] [--decode-shapes b16t1024,...]
+           [--shapes b8t1024,b4t2048,...]
+           [--decode-shapes b16t1024,b1s32t1024,...]
+       (decode specs are bB[sS]tT; s>1 sweeps the chunked-prefill
+       append-attention shapes.)
 """
 
 import argparse
@@ -48,10 +51,14 @@ from deepspeed_tpu.ops.transformer.kernels.decode_attention import (
 # medium's (the autotune signature keys on the full shape).
 DEFAULT_SHAPES = "b8t1024,b12t1024,b16t1024,b4t2048,b8t2048,b2t4096,b4t4096"
 
-# (slots, cache plane len) decode grid — bench.py --serve runs 16 slots
-# at a 1024-position pool; the longer planes cover larger serving
-# configs. S=1: the decode scan's query shape.
-DEFAULT_DECODE_SHAPES = "b16t1024,b16t2048,b8t2048,b8t4096"
+# (slots[, q_len], cache plane len) decode grid — bench.py --serve runs
+# 16 slots at a 1024-position pool; the longer planes cover larger
+# serving configs. No sNN means s=1 (the decode scan's query shape);
+# the sNN entries are the chunked-prefill APPEND shapes — the engine's
+# mixed step appends a [1, prefill_chunk] prompt slice through the same
+# kernel, so its q_len>1 signature needs its own tuned kv tile.
+DEFAULT_DECODE_SHAPES = ("b16t1024,b16t2048,b8t2048,b8t4096,"
+                         "b1s32t1024,b1s32t2048,b1s64t2048")
 
 
 def sweep_flash(args, swept_keys):
@@ -82,19 +89,25 @@ def sweep_decode(args, swept_keys):
         spec = spec.strip()
         if not spec:
             continue
-        b, t = (int(x) for x in spec[1:].split("t"))
-        q = jnp.asarray(rng.randn(b, args.heads, 1, args.dim), jnp.bfloat16)
+        # Spec grammar: bB[sS]tT — s defaults to 1 (pure decode); s>1 is
+        # a chunked-prefill append slice.
+        body, t = spec[1:].split("t")
+        b, s = (int(x) for x in body.split("s")) if "s" in body \
+            else (int(body), 1)
+        t = int(t)
+        q = jnp.asarray(rng.randn(b, args.heads, s, args.dim), jnp.bfloat16)
         k = jnp.asarray(rng.randn(b, args.heads, t, args.dim), jnp.bfloat16)
         v = jnp.asarray(rng.randn(b, args.heads, t, args.dim), jnp.bfloat16)
-        # Worst-case frontier (t-1: every kv block active) — the sweep
-        # inside resolve_decode_block times the same frontier, so the
-        # tuned tile is the end-of-generation one.
-        pos = jnp.full((b,), t - 1, jnp.int32)
+        # Worst-case frontier (every kv block active; the append's S new
+        # rows still fit the plane) — the sweep inside
+        # resolve_decode_block times the same frontier, so the tuned
+        # tile is the end-of-generation one.
+        pos = jnp.full((b,), t - s, jnp.int32)
         out = flash_decode_attention(q, k, v, pos)
         out.block_until_ready()
         swept_keys.append(autotuner.table_key(
             "decode_attention",
-            decode_signature(b, args.heads, 1, t, args.dim, jnp.bfloat16)))
+            decode_signature(b, args.heads, s, t, args.dim, jnp.bfloat16)))
         print("swept decode", spec, flush=True)
 
 

@@ -68,6 +68,52 @@ def test_prefill_rows_non_sublane_aligned():
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
 
 
+def test_append_chunk_rows_at_deep_frontiers():
+    """Chunked-prefill append shapes: a [B, C] chunk of queries landing
+    MID-CACHE (frontier well past 0 — the engine's second and later
+    prompt chunks), including a frontier whose chunk exactly fills the
+    plane. The per-row stagger must hold at every depth."""
+    rng = np.random.RandomState(6)
+    b, h, s, t, d = 3, 2, 32, 256, 32
+    q, k, v = qkv(rng, b, h, s, t, d)
+    pos = jnp.asarray([32, 131, 224], jnp.int32)  # 224 + 32 == t exactly
+    out = flash_decode_attention(q, k, v, pos, block_k=64)
+    ref = decode_attention_reference(q, k, v, pos)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    # A ragged, non-sublane chunk (the prompt's last slice) mid-cache.
+    q2 = q[:, :, :5]
+    out = flash_decode_attention(q2, k, v, pos, block_k=64)
+    ref = decode_attention_reference(q2, k, v, pos)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_append_forward_flag_parity():
+    """append_forward (the chunked-prefill primitive) through both
+    attention paths: appending a chunk at a non-zero frontier under the
+    flash kernel matches the einsum path's logits."""
+    from deepspeed_tpu.models.generation import append_forward
+
+    cfg = GPT2Config.tiny(dropout=0.0, dtype=jnp.float32,
+                          use_flash_attention=False)
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.RandomState(7)
+    ids = rng.randint(0, cfg.vocab_size, size=(1, 12)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))["params"]
+    chunk = rng.randint(0, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+
+    outs = {}
+    for flash in (False, True):
+        g = as_gencfg(cfg, use_flash_decode=flash)
+        cache = init_cache(g, 1, 128)  # kernel quantum so flash engages
+        _, cache = _forward(params, g, jnp.asarray(ids), cache)
+        logits, cache = append_forward(params, g, jnp.asarray(chunk), cache,
+                                       n_valid=jnp.asarray([5]))
+        assert int(cache["pos"][0]) == 12 + 5
+        outs[flash] = np.asarray(logits)[0, :5]
+    np.testing.assert_allclose(outs[True], outs[False],
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_single_kv_block_path():
     """block_k == T collapses to the direct-softmax branch (no scratch)."""
     rng = np.random.RandomState(3)

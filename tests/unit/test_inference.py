@@ -4,9 +4,12 @@ The contract under test, in order of importance:
 1. GREEDY PARITY — tokens out of the slotted engine are identical to
    sequential ``models.generation.generate`` calls, whatever the
    admission order or slot placement (ISSUE acceptance criterion).
-2. BOUNDED COMPILATION — after warmup (one prefill per prompt bucket +
-   one decode chunk program), a changing request mix causes ZERO
-   recompiles, asserted on the engines' jit cache-miss counters.
+2. BOUNDED COMPILATION — after warmup (ONE mixed-step program under
+   chunked prefill, the default; one prefill per prompt bucket + one
+   decode chunk program on the legacy path), a changing request mix
+   causes ZERO recompiles, asserted on the engines' jit cache-miss
+   counters. (tests/unit/test_chunked_prefill.py holds the
+   chunked-specific compile-count regression guard.)
 3. SCHEDULING — FIFO admission at chunk boundaries only, eviction on
    EOS/budget, QueueFull backpressure.
 4. TP SERVING — the same engine over a 'model'-axis mesh shards params
@@ -158,9 +161,9 @@ def test_staggered_stream_parity_and_zero_recompiles():
     news = [6, 3, 9, 5, 7, 4, 8, 6]
     ps = prompts_of(cfg, lens)
     reqs = [eng.submit(ps[i], max_new_tokens=news[i]) for i in range(3)]
-    eng.step()  # warmup: one bucket-16 prefill + one decode chunk
+    eng.step()  # warmup: the one mixed step (chunked prefill default)
     warm = eng.compile_count
-    assert warm == 2, "expected 1 prefill + 1 decode program, got " \
+    assert warm == 1, "expected the single mixed-step program, got " \
         "{}".format(warm)
     # Trickle in the rest while earlier requests are mid-flight.
     for i in range(3, len(ps)):
@@ -181,8 +184,10 @@ def test_staggered_stream_parity_and_zero_recompiles():
 
 
 def test_second_bucket_compiles_once_then_stays():
+    # LEGACY path: the bucket table only applies with chunked prefill off.
     cfg, model, params = make_model()
-    eng = engine_of(model, params, prefill_buckets=(8, 16))
+    eng = engine_of(model, params, prefill_buckets=(8, 16),
+                    chunked_prefill=False)
     eng.generate(prompts_of(cfg, [4]), max_new_tokens=2)
     assert eng.compile_count == 2
     eng.generate(prompts_of(cfg, [12]), max_new_tokens=2)  # new bucket
@@ -225,14 +230,18 @@ def test_submit_validation_and_backpressure():
     eng = engine_of(model, params, max_slots=1, max_queue=2)
     with pytest.raises(ValueError, match="empty"):
         eng.submit([])
-    with pytest.raises(ValueError, match="bucket"):
-        eng.submit(prompts_of(cfg, [17])[0])  # over the only bucket
+    # Chunked prefill has no bucket ceiling — only max_len bounds it.
     with pytest.raises(ValueError, match="max_len"):
         eng.submit(prompts_of(cfg, [10])[0], max_new_tokens=60)
-    eng.submit(prompts_of(cfg, [4])[0], max_new_tokens=2)
+    eng.submit(prompts_of(cfg, [17])[0], max_new_tokens=2)  # fine here
     eng.submit(prompts_of(cfg, [4])[0], max_new_tokens=2)
     with pytest.raises(QueueFull):
         eng.submit(prompts_of(cfg, [4])[0], max_new_tokens=2)
+    # Legacy path: prompts must also fit a prefill bucket.
+    leg = engine_of(model, params, max_slots=1, max_queue=2,
+                    chunked_prefill=False)
+    with pytest.raises(ValueError, match="bucket"):
+        leg.submit(prompts_of(cfg, [17])[0])  # over the only bucket (16)
 
 
 def test_sampled_decode_is_deterministic_per_seed():
@@ -276,15 +285,15 @@ def test_flash_decode_engine_token_parity_and_zero_recompiles():
     cfg, model, params = make_model()
     eng = engine_of(model, params, use_flash_decode=True, max_slots=3)
     assert eng.metrics()["flash_decode"] is True
-    # config max_len=64 -> plane padded to the kernel quantum.
+    # config max_len=64 + prefill_chunk=32 slack -> padded to the quantum.
     assert eng._pool["k"].shape[3] == 128
     lens = [5, 9, 3, 12]
     news = [6, 3, 7, 5]
     ps = prompts_of(cfg, lens)
     reqs = [eng.submit(ps[i], max_new_tokens=news[i]) for i in range(2)]
-    eng.step()  # warmup: one prefill + one decode chunk
+    eng.step()  # warmup: the one mixed step
     warm = eng.compile_count
-    assert warm == 2
+    assert warm == 1
     for i in range(2, len(ps)):
         reqs.append(eng.submit(ps[i], max_new_tokens=news[i]))
         eng.step()
@@ -305,7 +314,9 @@ def test_flash_decode_flag_resolution():
     cfg, model, params = make_model()
     eng = engine_of(model, params)  # None -> CPU default: off
     assert eng.metrics()["flash_decode"] is False
-    assert eng._pool["k"].shape[3] == 64  # no padding on the einsum path
+    # Einsum path: no quantum padding, just max_len=64 + the
+    # prefill_chunk=32 append slack.
+    assert eng._pool["k"].shape[3] == 96
     eng = engine_of(model, params, use_flash_decode=False)
     assert eng.metrics()["flash_decode"] is False
 
@@ -333,4 +344,4 @@ def test_tensor_sharded_serving_matches_unsharded(eight_devices):
     # The pool's k/v really are head-sharded over 'model'.
     spec = eng._pool["k"].sharding.spec
     assert spec[2] == mesh_lib.MODEL_AXIS
-    assert eng.compile_count == 2
+    assert eng.compile_count == 1  # the one mixed-step program
