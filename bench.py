@@ -64,7 +64,7 @@ def _probe_once(timeout):
     return True, ""
 
 
-def _device_probe(budget=480, attempt_timeout=180, probe=_probe_once,
+def _device_probe(budget=480, attempt_timeout=None, probe=_probe_once,
                   sleep=time.sleep):
     """True if the accelerator backend initializes within ``budget`` secs.
 
@@ -77,27 +77,48 @@ def _device_probe(budget=480, attempt_timeout=180, probe=_probe_once,
     the last driver-visible TPU result (docs/last_good_tpu.json) so a
     wedge never reads as a perf regression.
 
+    A HEALTHY backend initializes in well under a minute, so the FIRST
+    attempt gets a short timeout (45 s — a wedged relay just hangs, and
+    a 180 s first wait burned most of the retry budget learning nothing
+    in BENCH_r05); later attempts wait the full 180 s in case the relay
+    is slow rather than dead. ``DS_TPU_BENCH_PROBE_TIMEOUT`` (seconds)
+    overrides BOTH timeouts and ``DS_TPU_BENCH_PROBE_ATTEMPTS`` caps the
+    attempt count — the driver's knobs for environments where the wedge
+    verdict is already known. The explicit ``attempt_timeout`` argument
+    (tests) also overrides both.
+
     Only runs in the tunneled-relay environment (PALLAS_AXON_POOL_IPS):
     a healthy deployment should not pay backend init twice."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu" or \
             not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return True
+    env_t = os.environ.get("DS_TPU_BENCH_PROBE_TIMEOUT")
+    if attempt_timeout is not None:
+        first_timeout = later_timeout = attempt_timeout
+    elif env_t:
+        first_timeout = later_timeout = float(env_t)
+    else:
+        first_timeout, later_timeout = 45.0, 180.0
+    max_attempts = int(os.environ.get("DS_TPU_BENCH_PROBE_ATTEMPTS", "0")
+                       or 0)
     deadline = time.time() + budget
     backoff = 15
     attempt = 0
     while True:
         attempt += 1
         remaining = deadline - time.time()
-        if remaining <= 0:
+        if remaining <= 0 or (max_attempts and attempt > max_attempts):
             print("bench: giving up on accelerator after {} attempts / "
                   "{}s budget".format(attempt - 1, budget), file=sys.stderr)
             return False
-        ok, reason = probe(min(attempt_timeout, max(30, remaining)))
+        t = first_timeout if attempt == 1 else later_timeout
+        ok, reason = probe(min(t, max(30, remaining)))
         if ok:
             return True
         print("bench: accelerator probe attempt {} failed ({})".format(
             attempt, reason), file=sys.stderr)
-        if time.time() + backoff >= deadline:
+        if time.time() + backoff >= deadline or \
+                (max_attempts and attempt >= max_attempts):
             print("bench: giving up on accelerator after {} attempts / "
                   "{}s budget".format(attempt, budget), file=sys.stderr)
             return False
@@ -258,6 +279,11 @@ def _emit(result):
     fallback = os.environ.get("DS_BENCH_FALLBACK")
     if fallback:
         result["extra"]["fallback"] = fallback
+        # Machine-readable marker that THIS line was measured on the CPU
+        # fallback path (previously only a stderr log line said so —
+        # drivers parsing the JSON could mistake the smoke number for an
+        # accelerator measurement).
+        result["extra"]["probe_fallback"] = "cpu"
         metric = _FALLBACK_METRIC_FOR.get(result["metric"],
                                           result["metric"])
         last = _load_last_good(metric)
@@ -676,13 +702,15 @@ def _measure_bert(sparse, steps):
     })
 
 
-def _decode_attention_probe(engine, reps=10):
+def _decode_attention_probe(engine, reps=10, s=1):
     """Jitted micro-timing of ONE layer's decode-attention op at the
     engine's decode shape (worst-case frontier: every block active), on
     whichever path the engine engaged — flash kernel or dense einsum. The
     serving metric can't isolate the attention op from the rest of the
     decode step; this number makes the kernel A/B attributable in the
-    bench artifact. Returns (ms_per_call, engaged_flash)."""
+    bench artifact. ``s`` is the query width per step — 1 for plain
+    decode, spec_k+1 when the speculative verify lane is the step shape.
+    Returns (ms_per_call, engaged_flash)."""
     import jax
     import jax.numpy as jnp
 
@@ -693,10 +721,10 @@ def _decode_attention_probe(engine, reps=10):
     h, d = g.n_head, g.n_embd // g.n_head
     t = engine._pool["k"].shape[3]
     rng = np.random.RandomState(0)
-    q = jnp.asarray(rng.randn(b, h, 1, d), g.dtype)
+    q = jnp.asarray(rng.randn(b, h, s, d), g.dtype)
     k = jnp.asarray(rng.randn(b, h, t, d), g.dtype)
     v = jnp.asarray(rng.randn(b, h, t, d), g.dtype)
-    pos = jnp.full((b,), t - 1, jnp.int32)
+    pos = jnp.full((b,), t - s, jnp.int32)
     use_flash = bool(g.use_flash_decode) and da.decode_supported(t)
     fn = da.flash_decode_attention if use_flash \
         else da.decode_attention_reference
@@ -710,7 +738,8 @@ def _decode_attention_probe(engine, reps=10):
     return (time.time() - t0) / reps * 1e3, use_flash
 
 
-def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True):
+def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
+                     spec_decode=True):
     """Continuous-batching serving benchmark (deepspeed_tpu/inference/).
 
     A synthetic Poisson request stream plays against the slotted engine:
@@ -726,7 +755,14 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True):
     ``--no-flash-decode`` sets False for the einsum side of the kernel
     A/B. ``chunked_prefill=False`` (``--no-chunked-prefill``) runs the
     legacy whole-prompt-bucket prefill path — the A/B that shows chunked
-    prefill's TTFT-p99 win at equal-or-better tok/s."""
+    prefill's TTFT-p99 win at equal-or-better tok/s. ``spec_decode``
+    enables n-gram speculative decoding (``--no-spec-decode`` for the
+    A/B; it also stays off on the legacy path, which has no speculation
+    lane); the stamped ``accepted_per_step_*`` / ``draft_accept_rate``
+    metrics attribute any throughput delta to draft acceptance. The
+    prompts are REPETITION-HEAVY (each tiles its own short phrase) — the
+    workload where prompt-lookup drafting has matches to find; the
+    non-spec A/B serves the identical stream."""
     import jax
 
     import deepspeed_tpu as deepspeed
@@ -753,6 +789,8 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True):
     if flash_decode is not None:
         serve_cfg["use_flash_decode"] = flash_decode
     serve_cfg["chunked_prefill"] = chunked_prefill
+    spec_on = bool(spec_decode and chunked_prefill)
+    serve_cfg["spec_decode"] = spec_on
 
     model = GPT2LMHeadModel(cfg)
     rng = np.random.RandomState(0)
@@ -765,9 +803,13 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True):
 
     # The stream: lengths from a SMALL set (each distinct length is one
     # sequential-baseline compile; the engine itself buckets them).
+    # Repetition-heavy content: each request tiles its OWN random phrase
+    # to length — natural text repeats itself, uniform-random tokens
+    # never do, and the n-gram drafter needs self-matches to draft from.
+    # Identical stream on the spec and non-spec sides of the A/B.
     lens = [int(prompt_lens[i % len(prompt_lens)]) for i in range(n_req)]
-    prompts = [rng.randint(0, cfg.vocab_size, size=(n,)).astype(np.int32)
-               for n in lens]
+    prompts = [np.tile(rng.randint(0, cfg.vocab_size, size=(8,)),
+                       -(-n // 8))[:n].astype(np.int32) for n in lens]
     arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
 
     # Warmup: chunked prefill compiles its ONE mixed-step program on the
@@ -817,12 +859,15 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True):
     tok_per_sec = toks_out / wall
 
     # Kernel A/B attribution: which decode-attention path served, its
-    # planned tile, and the isolated per-step op time.
+    # planned tile, and the isolated per-step op time — probed at the
+    # step's ACTUAL query width (spec_k+1 under speculation: the verify
+    # lane is the step shape the kernel serves).
     g = engine._gcfg
     plane_len = int(engine._pool["k"].shape[3])
-    attn_ms, engaged = _decode_attention_probe(engine)
+    s_probe = engine.config.spec_k + 1 if spec_on else 1
+    attn_ms, engaged = _decode_attention_probe(engine, s=s_probe)
     block_k = da.planned_block_k(
-        serve_cfg["max_slots"], g.n_head, 1, plane_len,
+        serve_cfg["max_slots"], g.n_head, s_probe, plane_len,
         g.n_embd // g.n_head, g.dtype) if engaged else None
     decode_steps = (m["chunks"] - warm_chunks) * serve_cfg["chunk_size"]
     decode_s = m["decode_seconds"] - warm_decode_s
@@ -835,6 +880,8 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True):
         name += "_noflashdecode"
     if not chunked_prefill:
         name += "_nochunkedprefill"
+    if not spec_decode:
+        name += "_nospecdecode"
     return {
         "metric": name,
         "value": round(tok_per_sec, 1),
@@ -862,6 +909,13 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True):
             "chunk_size": serve_cfg["chunk_size"],
             "chunked_prefill": chunked_prefill,
             "prefill_chunk": m["prefill_chunk"] if chunked_prefill else None,
+            "spec_decode": spec_on,
+            "spec_k": m.get("spec_k"),
+            "spec_ngram": m.get("spec_ngram"),
+            "accepted_per_step_mean": m.get("accepted_per_step_mean"),
+            "accepted_per_step_p50": m.get("accepted_per_step_p50"),
+            "accepted_per_step_p99": m.get("accepted_per_step_p99"),
+            "draft_accept_rate": m.get("draft_accept_rate"),
             "flash_decode": engaged,
             "decode_block_k": block_k,
             "kv_plane_len": plane_len,
@@ -873,11 +927,13 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True):
     }
 
 
-def main_serve(smoke=False, flash_decode=None, chunked_prefill=True):
+def main_serve(smoke=False, flash_decode=None, chunked_prefill=True,
+               spec_decode=True):
     if not smoke:
         _require_tpu_or_exit()
     _emit(_measure_serving(smoke=smoke, flash_decode=flash_decode,
-                           chunked_prefill=chunked_prefill))
+                           chunked_prefill=chunked_prefill,
+                           spec_decode=spec_decode))
     return 0
 
 
@@ -919,14 +975,18 @@ def _dispatch(argv):
     # (default None lets the engine pick — the Pallas kernel on TPU).
     # --no-chunked-prefill: the legacy whole-prompt-bucket prefill side
     # of the chunked-prefill A/B (default True — the fused mixed step).
+    # --no-spec-decode: the draft-free side of the speculative-decoding
+    # A/B (default True — n-gram drafting on; metric suffixed
+    # _nospecdecode so the series never mix).
     flash_decode = False if "--no-flash-decode" in argv else None
     chunked = "--no-chunked-prefill" not in argv
+    spec = "--no-spec-decode" not in argv
     if "--serve-smoke" in argv:
         return main_serve(smoke=True, flash_decode=flash_decode,
-                          chunked_prefill=chunked)
+                          chunked_prefill=chunked, spec_decode=spec)
     if "--serve" in argv:
         return main_serve(flash_decode=flash_decode,
-                          chunked_prefill=chunked)
+                          chunked_prefill=chunked, spec_decode=spec)
     if "--sweep" in argv:
         return main_sweep()
     if "--xl-compute" in argv:

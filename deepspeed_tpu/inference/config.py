@@ -14,6 +14,7 @@ legacy path (``chunked_prefill=False``).
 """
 
 import dataclasses
+import os
 from typing import Optional, Tuple
 
 # The JSON block under "inference" in ds_config (runtime/config.py reads
@@ -29,6 +30,9 @@ INFERENCE_DEFAULTS = {
     "use_flash_decode": None,
     "chunked_prefill": True,
     "prefill_chunk": 32,
+    "spec_decode": None,
+    "spec_k": 4,
+    "spec_ngram": 3,
 }
 
 
@@ -91,6 +95,23 @@ class InferenceConfig:
     # step adds for already-decoding slots. Also the KV plane slack the
     # pool over-allocates so frontier writes never clamp.
     prefill_chunk: int = 32
+    # Speculative decoding (n-gram self-drafting + multi-token verify,
+    # fused into the mixed-step program — engine.py docstring): True
+    # enables it engine-wide, False disables, None defers to the
+    # DS_TPU_SPEC_DECODE env and then to OFF (opt-in: acceptance depends
+    # on workload repetitiveness, and the verify pass widens every decode
+    # step from 1 to spec_k+1 query rows). Requires chunked_prefill —
+    # speculation rides the mixed-step program's decode lane. Per-request
+    # opt-out via submit(spec_decode=False) cohabits the same program.
+    spec_decode: Optional[bool] = None
+    # Draft length K: each decode step verifies K drafted tokens plus the
+    # frontier token in one K+1-row forward, emitting 1..K+1 tokens.
+    # Larger K wins more on repetitive output but pays a wider verify
+    # whether or not the draft survives.
+    spec_k: int = 4
+    # N-gram length the drafter matches against the slot's own context.
+    # Longer n-grams fire less often but predict better when they do.
+    spec_ngram: int = 3
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -105,6 +126,17 @@ class InferenceConfig:
         if self.prefill_chunk < 1:
             raise ValueError("inference.prefill_chunk must be >= 1, got "
                              "{}".format(self.prefill_chunk))
+        if self.spec_k < 1:
+            raise ValueError("inference.spec_k must be >= 1, got "
+                             "{}".format(self.spec_k))
+        if self.spec_ngram < 1:
+            raise ValueError("inference.spec_ngram must be >= 1, got "
+                             "{}".format(self.spec_ngram))
+        if self.spec_decode and not self.chunked_prefill:
+            raise ValueError(
+                "inference.spec_decode=True requires chunked_prefill: "
+                "speculation is fused into the mixed-step program's decode "
+                "lane (the legacy bucket path has no speculation lane)")
         buckets = self.prefill_buckets
         if buckets is None:
             buckets = default_buckets(self.max_len)
@@ -141,6 +173,21 @@ class InferenceConfig:
             "prompt of {} tokens exceeds the largest prefill bucket {} "
             "(max_len={})".format(prompt_len, self.prefill_buckets[-1],
                                   self.max_len))
+
+    def resolved_spec_decode(self):
+        """The effective speculative-decoding switch: the explicit field
+        wins; ``None`` defers to the ``DS_TPU_SPEC_DECODE`` env (any
+        value but ``0``/``false`` turns it on — the bench/driver hook),
+        and the env only applies where speculation CAN run (chunked
+        prefill); the final default is off."""
+        if self.spec_decode is not None:
+            return bool(self.spec_decode)
+        if not self.chunked_prefill:
+            return False
+        env = os.environ.get("DS_TPU_SPEC_DECODE", "")
+        if env:
+            return env not in ("0", "false")
+        return False
 
     def validate_against_model(self, n_positions):
         if self.max_len > n_positions:

@@ -15,6 +15,21 @@ OSDI'24) serves every request mix with ONE jitted program:
   decode never stalls behind a long prompt (bounded TTFT instead of
   head-of-line blocking).
 
+Speculative decoding (``spec_decode`` — Leviathan et al., ICML'23, in
+its draft-model-free prompt-lookup form) swaps the decode lane's scan
+body for a DRAFT/VERIFY step, still inside the same single program: each
+slot drafts ``spec_k`` tokens by n-gram lookup over its own token ring
+(``models.generation.ngram_draft`` — pure device work, no host sync),
+one ``verify_forward`` scores all ``spec_k+1`` positions at the slot's
+frontier, and the longest draft prefix agreeing with the model's own
+choices is accepted — 1..spec_k+1 tokens per slot per step. Rollback of
+rejected tokens is FREE: their k/v sit past the un-advanced frontier
+where the stale-cache rule already masks or overwrites them. Greedy
+output stays bit-identical to ``generate`` (acceptance only ever keeps
+tokens the model itself would have chosen), and per-request opt-out
+(``submit(spec_decode=False)``) rides the same program via a traced
+per-slot flag that vetoes draft agreement.
+
 ``chunked_prefill=False`` restores the legacy pair — PREFILL (one
 compile per prompt bucket: whole prompt at batch dim 1, decode stalled
 while it runs) + DECODE CHUNK — for A/B runs (`bench.py --serve
@@ -47,6 +62,7 @@ import numpy as np
 from deepspeed_tpu.inference.config import InferenceConfig
 from deepspeed_tpu.inference.kv_pool import (
     cache_view,
+    harvest_snapshot,
     init_pool,
     max_active_frontier,
     pool_shardings,
@@ -173,9 +189,83 @@ def _decode_chunk_program(params, gcfg, chunk, pool):
     return pool, toks, valid
 
 
-def _mixed_step_program(params, gcfg, chunk, pool, p_ids, p_slot,
-                        p_frontier, p_valid, p_done, p_max_new, p_eos,
-                        p_temp, p_top_k, p_seed):
+def _spec_decode_chunk_program(params, gcfg, chunk, spec_k, spec_ngram,
+                               pool):
+    """The decode lane with SPECULATION: ``chunk`` draft/verify steps in
+    one scan. Each step, per slot: draft ``spec_k`` tokens by n-gram
+    lookup over the slot's token ring, score ``[last_tok, draft...]``
+    (spec_k+1 query rows) in ONE ``verify_forward`` at the frontier,
+    sample the model's own choice at every position with the SAME
+    positional rng the 1-token path uses (fold_in(seed, pos) names each
+    draw, so spec on/off produce identical streams even under
+    temperature sampling), accept the longest draft prefix agreeing with
+    those choices plus the one bonus choice after it, and advance the
+    frontier by the accepted count only. Rejected positions hold k/v and
+    ring garbage PAST the frontier — masked or overwritten before the
+    frontier reaches them (kv_pool's stale rule), so rollback costs
+    nothing. Slots with ``spec`` False get their agreement vetoed
+    (always 1 token — exactly the plain decode step), which is how spec
+    and non-spec requests cohabit one compiled program.
+
+    Returns (pool', tokens [chunk, slots, spec_k+1], valid [same]):
+    valid[t, s, i] marks tokens[t, s, i] as an accepted emission of slot
+    s at step t — row-major (step, lane) order is emission order."""
+    kp1 = spec_k + 1
+
+    def step(pool, _):
+        was_active = pool["active"]
+        old_pos = pool["pos"]
+        draft = generation.ngram_draft(pool["toks"], old_pos, spec_ngram,
+                                       spec_k)
+        ids = jnp.concatenate([pool["last_tok"][:, None], draft], axis=1)
+        logits, cache = generation.verify_forward(params, gcfg, ids,
+                                                  cache_view(pool))
+        R = ids.shape[0]
+        # choices[:, i] = the model's pick for position old_pos+1+i,
+        # conditioned on the draft prefix (== the true prefix wherever
+        # the prefix is accepted). Same sampler, same per-(seed, pos)
+        # rng as the 1-token path — bit-identical streams.
+        position = old_pos[:, None] + 1 + jnp.arange(kp1)[None]
+        choices = _sample_rows(
+            logits.reshape(R * kp1, -1),
+            jnp.repeat(pool["temp"], kp1), jnp.repeat(pool["top_k"], kp1),
+            jnp.repeat(pool["seed"], kp1),
+            position.reshape(-1)).reshape(R, kp1)
+        n_acc = generation.accept_counts(draft, choices,
+                                         ok=pool["spec"][:, None])
+        # Budget clamp first (the max() keeps frozen rows' gather index
+        # valid), then EOS truncation WITHIN the accepted prefix — the
+        # same emit-EOS-then-stop order as the 1-token path.
+        n_acc = jnp.minimum(n_acc, jnp.maximum(pool["remaining"], 1))
+        lane = jnp.arange(kp1)[None]
+        is_eos = (pool["eos"][:, None] >= 0) & \
+            (choices == pool["eos"][:, None]) & (lane < n_acc[:, None])
+        hit_eos = jnp.any(is_eos, axis=1)
+        n_acc = jnp.where(hit_eos, jnp.argmax(is_eos, axis=1) + 1, n_acc)
+        last = jnp.take_along_axis(choices, (n_acc - 1)[:, None],
+                                   axis=1)[:, 0]
+        remaining = jnp.where(was_active, pool["remaining"] - n_acc,
+                              pool["remaining"])
+        # Ring: ALL kp1 choices land at old_pos+1 (frozen rows included)
+        # — entries past the post-accept frontier are stale-rule garbage
+        # a later write covers before the drafter can match them.
+        ring = jax.vmap(lambda r, c, p: jax.lax.dynamic_update_slice(
+            r, c, (p + 1,)))(pool["toks"], choices, old_pos)
+        pool = dict(pool, k=cache["k"], v=cache["v"], toks=ring,
+                    pos=jnp.where(was_active, old_pos + n_acc, old_pos),
+                    last_tok=jnp.where(was_active, last, pool["last_tok"]),
+                    active=was_active & ~hit_eos & (remaining > 0),
+                    remaining=remaining)
+        ok = was_active[:, None] & (lane < n_acc[:, None])
+        return pool, (jnp.where(ok, choices, -1), ok)
+
+    pool, (toks, valid) = jax.lax.scan(step, pool, None, length=chunk)
+    return pool, toks, valid
+
+
+def _mixed_step_program(params, gcfg, chunk, spec, pool, p_ids, p_slot,
+                        p_frontier, p_valid, p_done, p_spec, p_max_new,
+                        p_eos, p_temp, p_top_k, p_seed):
     """One fused serving step — THE chunked-prefill program.
 
     PREFILL LANE: append ``p_ids`` [1, C] (``p_valid`` leading columns
@@ -187,14 +277,23 @@ def _mixed_step_program(params, gcfg, chunk, pool, p_ids, p_slot,
     the whole lane is skipped by ``lax.cond`` — an idle lane costs no
     FLOPs, so pure-decode steady state is unchanged.
 
-    DECODE LANE: the same scan as ``_decode_chunk_program``.
+    DECODE LANE: the same scan as ``_decode_chunk_program`` — or, when
+    ``spec`` (STATIC ``(spec_k, spec_ngram)`` or None) engages
+    speculation, ``_spec_decode_chunk_program``. ``spec`` is an
+    engine-lifetime constant, so the dispatch is baked at trace time and
+    the compile count stays 1 either way; ``p_spec`` (traced) is the
+    admitted request's per-slot opt-in. The lane additionally maintains
+    the token ring the drafter matches against: the prompt slice lands
+    at the frontier and the sampled first token at the new frontier.
 
-    Everything per-request is traced; ``chunk`` and the [1, C] slice
-    shape are the only static facts — ONE compile serves every
-    prompt-length mix, which is the whole compile-count contract.
+    Everything per-request is traced; ``chunk``, the [1, C] slice shape
+    and ``spec`` are the only static facts — ONE compile serves every
+    prompt-length and spec/non-spec mix, which is the whole
+    compile-count contract.
 
-    Returns (pool', first_token, tokens [chunk, slots], valid): the
-    first token is -1 unless ``p_done``.
+    Returns (pool', first_token, tokens, valid): the first token is -1
+    unless ``p_done``; tokens/valid are [chunk, slots] without
+    speculation, [chunk, slots, spec_k+1] with it.
     """
     C = p_ids.shape[1]
 
@@ -223,15 +322,29 @@ def _mixed_step_program(params, gcfg, chunk, pool, p_ids, p_slot,
                           ("active", p_done & ~finished),
                           ("remaining", p_max_new - 1), ("eos", p_eos),
                           ("temp", p_temp), ("top_k", p_top_k),
-                          ("seed", p_seed)):
+                          ("seed", p_seed), ("spec", p_spec)):
             pool[name] = pool[name].at[p_slot].set(
                 jnp.where(p_done, val, pool[name][p_slot]))
         pool["pos"] = pool["pos"].at[p_slot].set(p_frontier + p_valid)
+        if spec is not None:
+            # Token ring upkeep for the drafter: the slice's tokens at
+            # the frontier (pad columns write garbage past the advanced
+            # frontier — stale-rule inert), the first token at the new
+            # frontier once the prompt completes.
+            pool["toks"] = jax.lax.dynamic_update_slice(
+                pool["toks"], p_ids, (p_slot, p_frontier))
+            at_front = pool["toks"][p_slot, p_frontier + p_valid]
+            pool["toks"] = pool["toks"].at[p_slot, p_frontier + p_valid].set(
+                jnp.where(p_done, first, at_front))
         return pool, jnp.where(p_done, first, jnp.int32(-1))
 
     pool, first = jax.lax.cond(
         p_valid > 0, _lane, lambda pool: (pool, jnp.int32(-1)), pool)
-    pool, toks, valid = _decode_chunk_program(params, gcfg, chunk, pool)
+    if spec is None:
+        pool, toks, valid = _decode_chunk_program(params, gcfg, chunk, pool)
+    else:
+        pool, toks, valid = _spec_decode_chunk_program(
+            params, gcfg, chunk, spec[0], spec[1], pool)
     return pool, first, toks, valid
 
 
@@ -261,10 +374,21 @@ class InferenceEngine(object):
         self.mesh = mesh
         self._scheduler = Scheduler(config.max_slots, config.max_queue)
 
+        # Engine-lifetime speculation constant: (spec_k, spec_ngram) or
+        # None. STATIC — it rides the jit static args, so the spec
+        # dispatch is baked into the one mixed-step compile.
+        self._spec = ((config.spec_k, config.spec_ngram)
+                      if config.resolved_spec_decode() else None)
+
         # Chunked prefill appends up to prefill_chunk positions at a
         # frontier that can sit as deep as max_len-1 — the plane carries
         # that much slack so the write never clamps (kv_pool docstring).
+        # Speculation raises the floor to spec_k+1: a verify writes
+        # spec_k+1 k/v positions at the frontier and the ring takes the
+        # spec_k+1 choices one past it.
         slack = config.prefill_chunk if config.chunked_prefill else 0
+        if self._spec is not None:
+            slack = max(slack, config.spec_k + 1)
         pool = init_pool(self._gcfg, config.max_slots, config.max_len,
                          slack=slack)
         if mesh is not None and mesh_lib.mp_size(mesh) > 1:
@@ -298,8 +422,8 @@ class InferenceEngine(object):
             functools.partial(_decode_chunk_program), static_argnums=(1, 2),
             donate_argnums=(3,), out_shardings=decode_out)
         self._mixed = jax.jit(
-            functools.partial(_mixed_step_program), static_argnums=(1, 2),
-            donate_argnums=(3,), out_shardings=mixed_out)
+            functools.partial(_mixed_step_program), static_argnums=(1, 2, 3),
+            donate_argnums=(4,), out_shardings=mixed_out)
 
         self.timers = SynchronizedWallClockTimer()
         self.counters = {
@@ -307,16 +431,25 @@ class InferenceEngine(object):
             "prefill_tokens": 0, "requests_completed": 0,
             "occupied_slot_steps": 0, "slot_steps": 0,
         }
+        # accepted-tokens-per-occupied-slot-step histogram (index =
+        # count, 1..spec_k+1; index 0 stays empty — an occupied step
+        # always emits at least the bonus token). Bounded memory
+        # whatever the run length; metrics() derives mean/p50/p99 and
+        # the draft acceptance rate from it.
+        self._accept_hist = np.zeros(config.spec_k + 2, np.int64)
         self._t0 = time.time()
 
     # ------------------------------------------------------------- submit
 
     def submit(self, prompt, max_new_tokens=None, temperature=0.0,
-               top_k=None, eos_token_id=None, seed=0):
+               top_k=None, eos_token_id=None, seed=0, spec_decode=None):
         """Queue one request; returns its Request handle. Raises
         scheduler.QueueFull past ``max_queue`` pending requests
         (backpressure) and ValueError when the request cannot fit the
-        pool's static shapes (no silent truncation)."""
+        pool's static shapes (no silent truncation). ``spec_decode``:
+        None inherits the engine's switch, False opts this request out
+        (it cohabits the spec program with agreement vetoed — no
+        recompile), True demands an engine with speculation enabled."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -333,10 +466,17 @@ class InferenceEngine(object):
                                               self.config.max_len))
         if eos_token_id is None:
             eos_token_id = self.config.eos_token_id
+        if spec_decode and self._spec is None:
+            raise ValueError(
+                "submit(spec_decode=True) on an engine without speculation; "
+                "enable inference.spec_decode (or DS_TPU_SPEC_DECODE) at "
+                "engine construction — it sizes the KV-plane slack and the "
+                "compiled program")
         return self._scheduler.submit(
             prompt, int(max_new_tokens), float(temperature),
             int(top_k or 0), -1 if eos_token_id is None else int(eos_token_id),
-            int(seed))
+            int(seed),
+            spec=self._spec is not None and spec_decode is not False)
 
     # ------------------------------------------------------------- cancel
 
@@ -410,6 +550,7 @@ class InferenceEngine(object):
             ids[0, :n] = pf.prompt[cur:cur + n]
             slot, frontier, n_valid = pf.slot, cur, n
             p_done = cur + n >= pf.prompt.size
+            p_spec = pf.spec
             max_new, eos = pf.max_new_tokens, pf.eos_token_id
             temp, top_k, seed = pf.temperature, pf.top_k, pf.seed
         else:
@@ -417,23 +558,37 @@ class InferenceEngine(object):
             # program (lax.cond) — the remaining args are inert.
             slot = frontier = n_valid = 0
             p_done, max_new, eos, temp, top_k, seed = False, 1, -1, 0.0, 0, 0
+            p_spec = False
 
         self.timers("inference/decode").start()
         self._pool, first, toks, valid = self._mixed(
-            self._params, self._gcfg, self.config.chunk_size, self._pool,
-            jnp.asarray(ids), jnp.int32(slot), jnp.int32(frontier),
-            jnp.int32(n_valid), jnp.asarray(p_done), jnp.int32(max_new),
-            jnp.int32(eos), jnp.float32(temp), jnp.int32(top_k),
-            jnp.uint32(seed))
-        # ONE batched host sync per step: tokens, validity, occupancy and
+            self._params, self._gcfg, self.config.chunk_size, self._spec,
+            self._pool, jnp.asarray(ids), jnp.int32(slot),
+            jnp.int32(frontier), jnp.int32(n_valid), jnp.asarray(p_done),
+            jnp.asarray(p_spec), jnp.int32(max_new), jnp.int32(eos),
+            jnp.float32(temp), jnp.int32(top_k), jnp.uint32(seed))
+        # ONE batched host sync per step: tokens, validity, the per-slot
+        # scalar snapshot (pos/active/last_tok in a single transfer) and
         # the (possible) first token all land together.
         toks = np.asarray(toks)
         valid = np.asarray(valid)
-        active = np.asarray(self._pool["active"])
+        snap = harvest_snapshot(self._pool)
+        active = snap["active"]
         self.timers("inference/decode").stop()
         self.counters["chunks"] += 1
-        self.counters["occupied_slot_steps"] += int(valid.sum())
-        self.counters["slot_steps"] += valid.size
+        if toks.ndim == 2:
+            # Plain decode lane: one token per slot-step. Normalize to
+            # the speculative [chunk, slots, lanes] emission layout so
+            # the harvest below is one code path.
+            toks = toks[:, :, None]
+            valid = valid[:, :, None]
+        occupied = valid.any(axis=2)
+        self.counters["occupied_slot_steps"] += int(occupied.sum())
+        self.counters["slot_steps"] += occupied.size
+        if self._spec is not None:
+            self._accept_hist += np.bincount(
+                valid.sum(axis=2)[occupied],
+                minlength=self._accept_hist.size)
 
         if pf is not None:
             self.counters["prefill_tokens"] += n_valid
@@ -444,7 +599,9 @@ class InferenceEngine(object):
         for slot, req in list(self._scheduler.running.items()):
             if req.phase != "decoding":
                 continue  # mid-prefill slots emit nothing
-            emitted = toks[valid[:, slot], slot].tolist()
+            # Boolean-mask select flattens row-major — (step, lane) IS
+            # emission order.
+            emitted = toks[:, slot][valid[:, slot]].tolist()
             req.tokens.extend(emitted)
             self.counters["tokens_out"] += len(emitted)
             if not active[slot]:
@@ -474,7 +631,7 @@ class InferenceEngine(object):
             self.timers("inference/decode").stop()
             toks = np.asarray(toks)
             valid = np.asarray(valid)
-            active = np.asarray(self._pool["active"])
+            active = harvest_snapshot(self._pool)["active"]
             self.counters["chunks"] += 1
             self.counters["occupied_slot_steps"] += int(valid.sum())
             self.counters["slot_steps"] += valid.size
@@ -574,6 +731,30 @@ class InferenceEngine(object):
             "chunked_prefill": bool(self.config.chunked_prefill),
             "prefill_chunk": self.config.prefill_chunk,
             "max_active_frontier": max_active_frontier(self._pool),
+            "spec_decode": self._spec is not None,
         }
+        if self._spec is not None:
+            hist = self._accept_hist
+            n = int(hist.sum())
+            # Expand the bounded histogram back to per-step samples for
+            # exact percentiles (n = occupied slot-steps; tiny next to
+            # the tokens it describes).
+            acc = np.repeat(np.arange(hist.size), hist)
+            m.update({
+                "spec_k": self.config.spec_k,
+                "spec_ngram": self.config.spec_ngram,
+                "accepted_per_step_mean": (
+                    round(float(acc.mean()), 4) if n else None),
+                "accepted_per_step_p50": (
+                    float(np.percentile(acc, 50)) if n else None),
+                "accepted_per_step_p99": (
+                    float(np.percentile(acc, 99)) if n else None),
+                # Of the spec_k DRAFTED tokens per occupied step, the
+                # accepted fraction (the frontier token is not drafted —
+                # it is always emitted and excluded here).
+                "draft_accept_rate": (
+                    round(float((acc - 1).sum()) / (self.config.spec_k * n),
+                          4) if n else None),
+            })
         m.update(self._latency_percentiles())
         return m

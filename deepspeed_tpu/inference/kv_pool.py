@@ -21,7 +21,22 @@ Per-slot state vector (all ``[slots]``-shaped device arrays):
 - ``remaining``  new tokens this request may still emit;
 - ``eos``        per-request EOS id (-1: none);
 - ``temp``/``top_k``/``seed``  per-request sampling params, traced (a
-                 request mix never changes the program).
+                 request mix never changes the program);
+- ``spec``       speculative decoding enabled for this request (the
+                 accept rule vetoes draft agreement when False, so spec
+                 and non-spec requests cohabit one program).
+
+Speculation adds a TOKEN RING ``toks`` [slots, plane_len] (int32):
+position p holds the token the row placed there — prompt tokens during
+prefill, then every accepted (and the bonus) token as decode advances.
+It obeys the SAME stale rule as the k/v planes: positions ``<= pos[b]``
+are valid (``toks[b, pos[b]]`` == ``last_tok[b]``, the frontier token
+whose k/v are not yet written), anything past the frontier is garbage
+that a later write covers before the frontier reaches it. The n-gram
+drafter (models.generation.ngram_draft) only ever matches candidates
+strictly below the frontier, so it never reads garbage — and even a
+"lucky" garbage-continuation draft would merely be verified and
+rejected like any other wrong draft.
 
 Stale cache safety: an evicted slot's k/v are NOT cleared. Re-admission
 prefills positions ``0..Tp-1``, and decode writes position ``p`` before
@@ -43,7 +58,14 @@ Layout invariants the flash-decode kernel
   positions past ``max_len`` (then block-quantum padding on top), so an
   append's multi-position frontier write stays in bounds for every
   admissible frontier — slack positions are masked exactly like quantum
-  padding, never attended;
+  padding, never attended. Speculative decoding raises the floor to
+  ``spec_k + 1``: a verify writes k/v at ``pos..pos+spec_k`` and the
+  token ring takes the K+1 choices at ``pos+1..pos+spec_k+1``, both
+  from frontiers as deep as ``max_len - 1``, so the engine sizes
+  ``slack = max(prefill_chunk, spec_k + 1)`` and neither write ever
+  clamps (``dynamic_update_slice`` clamping would silently shift a
+  frontier write onto LIVE positions — the one failure mode this whole
+  slack scheme exists to rule out);
 - ``pos[b]`` is the PRE-write frontier: positions ``0..pos[b]-1`` hold
   the row's valid k/v, everything at ``>= pos[b] + S`` (after a write of
   S new positions) is zeros or a stale request's data. The kernel's
@@ -72,6 +94,7 @@ _SLOT_FIELDS = (
     ("temp", jnp.float32, 0.0),
     ("top_k", jnp.int32, 0),
     ("seed", jnp.uint32, 0),
+    ("spec", jnp.bool_, False),
 )
 
 
@@ -100,21 +123,42 @@ def init_pool(gcfg, num_slots, max_len, dtype=None, slack=0):
     if getattr(gcfg, "use_flash_decode", False):
         assert decode_attention.decode_supported(plane_len), plane_len
     kv_shape = (gcfg.n_layer, num_slots, gcfg.n_head, plane_len, hd)
-    pool = {"k": jnp.zeros(kv_shape, dtype), "v": jnp.zeros(kv_shape, dtype)}
+    pool = {"k": jnp.zeros(kv_shape, dtype), "v": jnp.zeros(kv_shape, dtype),
+            # Token ring for n-gram self-drafting (module docstring) —
+            # same length as the planes so ring writes share the slack
+            # bound; int32 [slots, plane_len] is noise next to the k/v.
+            "toks": jnp.zeros((num_slots, plane_len), jnp.int32)}
     for name, ft, fill in _SLOT_FIELDS:
         pool[name] = jnp.full((num_slots,), fill, ft)
     return pool
 
 
-def max_active_frontier(pool):
-    """Host-side hint: the largest frontier among ACTIVE slots (one small
-    device->host sync). The kernel already bounds its own work PER ROW
-    from ``pool['pos']`` via scalar prefetch; this cross-row bound is the
-    observability companion — the serving benchmark stamps it, and a
-    future work-partitioned grid can cap its length extent with it."""
+def harvest_snapshot(pool):
+    """ONE batched device->host transfer of every per-slot scalar the
+    host loop reads at a harvest boundary: ``pos`` / ``active`` /
+    ``last_tok`` land together, and ``free_slots`` /
+    ``max_active_frontier`` derive from the snapshot instead of each
+    paying its own sync (three round-trips per chunk collapse to one).
+    The snapshot is a plain dict of numpy arrays — valid until the next
+    program call moves the pool."""
     import numpy as np
-    pos = np.asarray(pool["pos"])
-    active = np.asarray(pool["active"])
+    pos, active, last = jax.device_get(
+        (pool["pos"], pool["active"], pool["last_tok"]))
+    return {"pos": np.asarray(pos), "active": np.asarray(active),
+            "last_tok": np.asarray(last)}
+
+
+def max_active_frontier(pool, snap=None):
+    """Host-side hint: the largest frontier among ACTIVE slots. The
+    kernel already bounds its own work PER ROW from ``pool['pos']`` via
+    scalar prefetch; this cross-row bound is the observability companion
+    — the serving benchmark stamps it, and a future work-partitioned
+    grid can cap its length extent with it. Pass ``snap`` (a
+    ``harvest_snapshot``) to reuse an already-paid transfer; without it
+    the call syncs on its own."""
+    if snap is None:
+        snap = harvest_snapshot(pool)
+    pos, active = snap["pos"], snap["active"]
     return int((pos * active).max()) if pos.size else 0
 
 
@@ -147,8 +191,11 @@ def shard_pool(mesh, pool, n_head):
     return {name: jax.device_put(arr, sh[name]) for name, arr in pool.items()}
 
 
-def free_slots(pool):
-    """Host-side: indices of inactive slots (a device->host sync of one
-    bool vector — the only per-chunk transfer besides emitted tokens)."""
+def free_slots(pool, snap=None):
+    """Host-side: indices of inactive slots. Pass ``snap`` (a
+    ``harvest_snapshot``) to derive from the harvest's single batched
+    transfer; without it the call pays its own device->host sync."""
     import numpy as np
-    return [int(i) for i in np.flatnonzero(~np.asarray(pool["active"]))]
+    if snap is None:
+        snap = harvest_snapshot(pool)
+    return [int(i) for i in np.flatnonzero(~snap["active"])]

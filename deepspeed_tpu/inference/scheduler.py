@@ -33,12 +33,12 @@ class Request(object):
     """One generation request and its accumulated output."""
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature", "top_k",
-                 "eos_token_id", "seed", "tokens", "slot", "phase", "cursor",
-                 "submit_time", "admit_time", "first_token_time",
+                 "eos_token_id", "seed", "spec", "tokens", "slot", "phase",
+                 "cursor", "submit_time", "admit_time", "first_token_time",
                  "finish_time")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature, top_k,
-                 eos_token_id, seed):
+                 eos_token_id, seed, spec=False):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -46,6 +46,13 @@ class Request(object):
         self.top_k = top_k
         self.eos_token_id = eos_token_id
         self.seed = seed
+        # Speculative decoding for THIS request (engine-wide switch AND
+        # per-request opt-in resolved at submit). Rides to the device as
+        # the slot's traced ``spec`` flag; a decode step may then emit
+        # 1..spec_k+1 tokens for the slot — ``tokens`` grows by the
+        # ACCEPTED count per step and the device-side ``remaining`` clamp
+        # keeps len(tokens) <= max_new_tokens exactly as in 1-token mode.
+        self.spec = spec
         self.tokens = []
         self.slot = None
         self.phase = "queued"
@@ -76,13 +83,13 @@ class Scheduler(object):
     # ------------------------------------------------------------ submit
 
     def submit(self, prompt, max_new_tokens, temperature, top_k,
-               eos_token_id, seed):
+               eos_token_id, seed, spec=False):
         if len(self.queue) >= self.max_queue:
             raise QueueFull(
                 "inference queue is full ({} pending); retry later or "
                 "raise inference.max_queue".format(len(self.queue)))
         req = Request(next(self._ids), prompt, max_new_tokens, temperature,
-                      top_k, eos_token_id, seed)
+                      top_k, eos_token_id, seed, spec)
         self.queue.append(req)
         return req
 

@@ -209,6 +209,83 @@ def decode_step(params, cfg, tok, cache):
     return logits[:, 0], cache
 
 
+def verify_forward(params, cfg, ids, cache):
+    """Score ``ids`` [B, S] at each row's frontier WITHOUT advancing it —
+    the speculative-decoding VERIFY primitive. Row b's ids are
+    [last_tok, draft_0 .. draft_{S-2}]: the token sitting at the frontier
+    followed by drafted candidates, so ``logits[b, i]`` is the model's
+    distribution for position ``pos[b] + i + 1`` — exactly what
+    ``decode_step`` would have produced after emitting the first i draft
+    tokens. k/v for ALL S positions are written in place (a draft token
+    that gets accepted already has correct cache entries — its k/v depend
+    only on the token id and position, both fixed at draft time), but
+    ``pos`` is returned UNCHANGED: the caller advances it by the accepted
+    count only, and rejected positions sit past the frontier where the
+    stale-cache rule (kv_pool docstring) masks or overwrites them —
+    rollback is simply not moving the frontier. The cache plane needs
+    S-1 positions of slack past the last admissible frontier so the
+    write never clamps (same contract as ``append_forward``)."""
+    pos0 = cache["pos"]
+    logits, cache = _forward(params, cfg, ids, cache)
+    return logits, dict(cache, pos=pos0)
+
+
+def ngram_draft(toks, pos, n, k):
+    """Prompt-lookup drafting (n-gram self-speculation): for each row,
+    find the MOST RECENT earlier occurrence of the row's trailing
+    ``n``-gram inside its own context ``toks[b, :pos[b]+1]`` (prompt +
+    tokens generated so far, with the undecoded frontier token at
+    ``pos[b]``) and propose the ``k`` tokens that followed it.
+
+    ``toks`` [B, T] is the token ring (positions > pos[b] may hold
+    garbage — candidates are masked to ``j < pos[b]`` so it is never
+    read); ``pos`` [B] the per-row frontiers; ``n``/``k`` are static.
+    Rows with no match (or frontiers shorter than the n-gram) fall back
+    to repeating the frontier token k times — an arbitrary but valid
+    draft: a wrong draft costs nothing beyond the verify FLOPs already
+    being paid, which is the whole economics of self-drafting. The
+    continuation gather is clipped to ``<= pos[b]``, so a match near the
+    frontier drafts from the (valid) suffix it overlaps. Returns int32
+    [B, k]."""
+    B, T = toks.shape
+    idx = jnp.arange(T)
+
+    def per_row(row, p):
+        last = row[jnp.clip(p, 0, T - 1)]
+        # match[j]: the n-gram ENDING at ring position j equals the one
+        # ending at the frontier p. Built from n static shift-compares;
+        # roll's wraparound only pollutes j < n-1, which the window mask
+        # excludes.
+        match = (idx >= n - 1) & (idx < p)
+        for i in range(n):
+            match &= jnp.roll(row, i) == row[jnp.clip(p - i, 0, T - 1)]
+        j = jnp.max(jnp.where(match, idx, -1))          # most recent
+        cont = row[jnp.clip(j + 1 + jnp.arange(k), 0, jnp.maximum(p, 0))]
+        return jnp.where(j >= 0, cont, jnp.full((k,), last))
+
+    return jax.vmap(per_row)(toks, pos.astype(jnp.int32)).astype(jnp.int32)
+
+
+def accept_counts(draft, choices, ok=None):
+    """Speculative ACCEPT rule: given per-row drafts [B, K] and the
+    model's own choices [B, K+1] from a verify pass (choices[:, i] is
+    what the model picks at position pos+i+1, via argmax or the
+    positional-rng sampler — either way conditioned on the draft prefix,
+    which equals the true prefix wherever it matters), return [B] counts
+    in ``1..K+1``: 1 (the always-correct choice at the original
+    frontier) + the length of the longest prefix where draft agrees with
+    choice. This is exact speculative decoding for deterministic
+    samplers: every emitted token is conditioned on an accepted —
+    therefore model-chosen — prefix, so the output stream is identical
+    to one-token-at-a-time decode. ``ok`` [B, 1] or [B, K] (optional)
+    vetoes agreement per row/lane (False forces count 1 — the non-spec
+    slots cohabiting a spec batch)."""
+    agree = draft == choices[:, :draft.shape[1]]
+    if ok is not None:
+        agree = agree & ok
+    return 1 + jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1), axis=1)
+
+
 def _sample(logits, rng, temperature, top_k):
     """[B, V] fp32 logits -> [B] token ids."""
     if temperature == 0.0:

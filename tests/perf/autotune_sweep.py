@@ -54,11 +54,16 @@ DEFAULT_SHAPES = "b8t1024,b12t1024,b16t1024,b4t2048,b8t2048,b2t4096,b4t4096"
 # (slots[, q_len], cache plane len) decode grid — bench.py --serve runs
 # 16 slots at a 1024-position pool; the longer planes cover larger
 # serving configs. No sNN means s=1 (the decode scan's query shape);
-# the sNN entries are the chunked-prefill APPEND shapes — the engine's
+# the b1sNN entries are the chunked-prefill APPEND shapes — the engine's
 # mixed step appends a [1, prefill_chunk] prompt slice through the same
-# kernel, so its q_len>1 signature needs its own tuned kv tile.
+# kernel, so its q_len>1 signature needs its own tuned kv tile. The
+# bNNs5 entries are the SPECULATIVE VERIFY shapes: with spec_decode on,
+# every decode step scores spec_k+1 query rows per slot (default
+# spec_k=4 -> s=5) through the same kernel, so the speculation lane's
+# signature gets its own tuned tile too.
 DEFAULT_DECODE_SHAPES = ("b16t1024,b16t2048,b8t2048,b8t4096,"
-                         "b1s32t1024,b1s32t2048,b1s64t2048")
+                         "b1s32t1024,b1s32t2048,b1s64t2048,"
+                         "b16s5t1024,b16s5t2048,b8s5t2048")
 
 
 def sweep_flash(args, swept_keys):

@@ -96,6 +96,73 @@ def test_probe_backoff_grows(bench, monkeypatch):
     assert sleeps[0] < sleeps[-1]
 
 
+def test_probe_first_attempt_timeout_is_short(bench, monkeypatch):
+    """A healthy backend inits in well under a minute; the FIRST attempt
+    must not burn 180s learning the relay is wedged (BENCH_r05)."""
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.delenv("DS_TPU_BENCH_PROBE_TIMEOUT", raising=False)
+    clock = FakeClock()
+    monkeypatch.setattr(bench.time, "time", clock.time)
+    timeouts = []
+
+    def probe(timeout):
+        clock.t += 30
+        timeouts.append(timeout)
+        return len(timeouts) >= 2, "wedged"
+
+    bench._device_probe(budget=480, probe=probe, sleep=clock.sleep)
+    assert timeouts[0] == 45.0
+    assert timeouts[1] == 180.0
+
+
+def test_probe_timeout_env_overrides_both_attempts(bench, monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("DS_TPU_BENCH_PROBE_TIMEOUT", "60")
+    clock = FakeClock()
+    monkeypatch.setattr(bench.time, "time", clock.time)
+    timeouts = []
+
+    def probe(timeout):
+        clock.t += 30
+        timeouts.append(timeout)
+        return len(timeouts) >= 3, "wedged"
+
+    bench._device_probe(budget=480, probe=probe, sleep=clock.sleep)
+    assert timeouts == [60.0, 60.0, 60.0]
+
+
+def test_probe_attempts_env_caps_retries(bench, monkeypatch):
+    """DS_TPU_BENCH_PROBE_ATTEMPTS=1: one failed probe is final — the
+    driver's knob when the wedge verdict is already known."""
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("DS_TPU_BENCH_PROBE_ATTEMPTS", "1")
+    clock = FakeClock()
+    monkeypatch.setattr(bench.time, "time", clock.time)
+    attempts = []
+
+    def probe(timeout):
+        clock.t += 10
+        attempts.append(timeout)
+        return False, "wedged"
+
+    assert not bench._device_probe(budget=480, probe=probe, sleep=clock.sleep)
+    assert len(attempts) == 1
+
+
+def test_emit_fallback_stamps_probe_fallback_marker(bench, monkeypatch,
+                                                    tmp_path, capsys):
+    """The fallback JSON must carry a machine-readable cpu marker —
+    drivers parsing the line must never mistake the smoke number for an
+    accelerator measurement."""
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH",
+                        str(tmp_path / "last_good_tpu.json"))
+    monkeypatch.setenv("DS_BENCH_FALLBACK", "accelerator-init-failed")
+    bench._emit({"metric": "m", "value": 100.0, "unit": "tok/s",
+                 "vs_baseline": 0.02, "extra": {"platform": "cpu"}})
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["extra"]["probe_fallback"] == "cpu"
+
+
 def test_emit_fallback_embeds_last_good(bench, monkeypatch, tmp_path,
                                         capsys):
     last = {"metric": "m", "value": 44955.0, "unit": "tok/s",
