@@ -25,6 +25,13 @@ REF_MFU = 0.52
 LAST_GOOD_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "docs", "last_good_tpu.json")
 
+# Per-attempt device-probe diagnostics, accumulated across the whole
+# supervised run (the fallback re-dispatches IN-PROCESS, so _emit sees
+# them). Embedded in the fallback JSON: the reader gets the wedge's
+# shape — how many attempts, how long each waited, what each saw —
+# instead of one "gave up" stderr line that the driver never captures.
+_PROBE_ATTEMPTS = []
+
 
 def _git_state():
     """Short commit hash of the measured code, '-dirty'-suffixed when the
@@ -111,8 +118,16 @@ def _device_probe(budget=480, attempt_timeout=None, probe=_probe_once,
             print("bench: giving up on accelerator after {} attempts / "
                   "{}s budget".format(attempt - 1, budget), file=sys.stderr)
             return False
-        t = first_timeout if attempt == 1 else later_timeout
-        ok, reason = probe(min(t, max(30, remaining)))
+        t = min(first_timeout if attempt == 1 else later_timeout,
+                max(30, remaining))
+        t_start = time.time()
+        ok, reason = probe(t)
+        _PROBE_ATTEMPTS.append({
+            "attempt": attempt,
+            "timeout_s": round(t, 1),
+            "elapsed_s": round(time.time() - t_start, 3),
+            "error": None if ok else reason,
+        })
         if ok:
             return True
         print("bench: accelerator probe attempt {} failed ({})".format(
@@ -316,8 +331,23 @@ def _emit(result):
             if stale is False and measured_at and "-dirty" in measured_at:
                 # Equal dirty hashes cannot prove equal code — say so.
                 result["extra"]["last_good_hash_dirty"] = True
-            result["vs_baseline"] = last.get("vs_baseline",
-                                             result["vs_baseline"])
+            if stale is True:
+                # A PROVABLY stale artifact (measured on a different
+                # commit) must not surface as this round's headline
+                # ratio: null it so the driver reads "no comparable
+                # number", with the full stale record still under
+                # extra.last_good_tpu for a human to weigh. UNKNOWN
+                # provenance (stale=None) still surfaces the ratio —
+                # suppressing on missing metadata would hide the only
+                # evidence a wedge leaves behind.
+                result["vs_baseline"] = None
+                result["extra"]["vs_baseline_suppressed"] = (
+                    "last_good_tpu hash is stale")
+            else:
+                result["vs_baseline"] = last.get("vs_baseline",
+                                                 result["vs_baseline"])
+    if fallback and _PROBE_ATTEMPTS:
+        result["extra"]["probe_attempts"] = list(_PROBE_ATTEMPTS)
     # flush: under the battery/supervisor stdout is a file; a later wedge
     # must not take this already-earned result line with it.
     print(json.dumps(result), flush=True)
@@ -814,35 +844,53 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
 
     # Warmup: chunked prefill compiles its ONE mixed-step program on the
     # first request; the legacy path needs one request per distinct
-    # bucket to compile every prefill program + the decode program. The
-    # timed stream then runs at the engine's zero-recompile steady state.
+    # bucket to compile every prefill program + the decode program.
+    # mark_warm() freezes that compile total in the recompile detector
+    # (the chunked path self-warms, the legacy path can't — it has no
+    # way to know the bucket mix is complete) and metrics(reset=True)
+    # opens a fresh window, so the measured phase's counters, timers and
+    # latency percentiles carry NO warmup pollution — the windowed
+    # replacement for the old warm_* subtraction bookkeeping.
+    from deepspeed_tpu.telemetry import PROFILE_DIR_ENV, profile_window
+
     engine.generate([prompts[lens.index(n)] for n in sorted(set(lens))],
                     max_new_tokens=2)
-    warm_compiles = engine.compile_count
-    # Post-warmup decode-timer snapshot: the per-token decode number must
-    # exclude the warmup chunks' compile time.
-    warm_decode_s = engine.timers("inference/decode").elapsed(reset=False)
-    warm_chunks = engine.counters["chunks"]
+    engine.recompile_detector.mark_warm()
+    engine.metrics(reset=True)
 
     t0 = time.time()
     submitted, reqs, done = 0, [], []
-    while len(done) < n_req:
-        now = time.time() - t0
-        while submitted < n_req and arrivals[submitted] <= now:
-            reqs.append(engine.submit(prompts[submitted],
-                                      max_new_tokens=max_new))
-            submitted += 1
-        if engine._scheduler.idle:
-            time.sleep(max(arrivals[submitted] - (time.time() - t0), 0.0))
-            continue
-        done.extend(engine.step())
+    with profile_window("serving"):
+        while len(done) < n_req:
+            now = time.time() - t0
+            while submitted < n_req and arrivals[submitted] <= now:
+                reqs.append(engine.submit(prompts[submitted],
+                                          max_new_tokens=max_new))
+                submitted += 1
+            if engine._scheduler.idle:
+                time.sleep(max(arrivals[submitted] - (time.time() - t0),
+                               0.0))
+                continue
+            done.extend(engine.step())
     wall = max(time.time() - t0, 1e-9)
 
     toks_out = sum(len(r.tokens) for r in reqs)
     ttft = [r.first_token_time - r.submit_time for r in reqs]
     per_tok = [(r.finish_time - r.first_token_time) /
                max(len(r.tokens) - 1, 1) for r in reqs]
-    m = engine.metrics()
+    # Close the measured window: every windowed number below (chunks,
+    # decode_seconds, occupancy, latency percentiles, accept stats)
+    # describes exactly the timed stream.
+    m = engine.metrics(reset=True)
+    telemetry = engine.telemetry_snapshot()
+    profile_dir = os.environ.get(PROFILE_DIR_ENV)
+    if profile_dir:
+        # The profiler capture landed under profile_dir via
+        # profile_window above; add the Chrome trace of the request
+        # lifecycle spans next to it (Perfetto loads both).
+        os.makedirs(profile_dir, exist_ok=True)
+        telemetry["trace_file"] = engine.write_trace(
+            os.path.join(profile_dir, "serving_trace.json"))
 
     # Sequential baseline: the same prompts, one at a time, greedy — the
     # pre-continuous-batching serving story. Warm each distinct length
@@ -869,8 +917,9 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
     block_k = da.planned_block_k(
         serve_cfg["max_slots"], g.n_head, s_probe, plane_len,
         g.n_embd // g.n_head, g.dtype) if engaged else None
-    decode_steps = (m["chunks"] - warm_chunks) * serve_cfg["chunk_size"]
-    decode_s = m["decode_seconds"] - warm_decode_s
+    # Windowed snapshot: chunks/decode_seconds already exclude warmup.
+    decode_steps = m["chunks"] * serve_cfg["chunk_size"]
+    decode_s = m["decode_seconds"]
 
     name = "gpt2_{}_serving_tokens_per_sec".format(
         "355m" if on_tpu else "tiny_smoke" if smoke else "tiny")
@@ -904,7 +953,7 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
             "slot_occupancy": round(m["slot_occupancy"], 4),
             "sequential_tokens_per_sec": round(seq_tok_per_sec, 1),
             "compile_count": m["compile_count"],
-            "recompiles_after_warmup": m["compile_count"] - warm_compiles,
+            "recompiles_after_warmup": m["recompiles"],
             "max_slots": serve_cfg["max_slots"],
             "chunk_size": serve_cfg["chunk_size"],
             "chunked_prefill": chunked_prefill,
@@ -923,6 +972,7 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
             "decode_attention_ms_per_step": round(attn_ms * g.n_layer, 4),
             "decode_ms_per_token": round(
                 decode_s / max(decode_steps, 1) * 1e3, 4),
+            "telemetry": telemetry,
         },
     }
 

@@ -33,6 +33,8 @@ INFERENCE_DEFAULTS = {
     "spec_decode": None,
     "spec_k": 4,
     "spec_ngram": 3,
+    "telemetry": True,
+    "trace_ring": 4096,
 }
 
 
@@ -112,6 +114,16 @@ class InferenceConfig:
     # N-gram length the drafter matches against the slot's own context.
     # Longer n-grams fire less often but predict better when they do.
     spec_ngram: int = 3
+    # Telemetry (telemetry/): per-request trace spans, profiler
+    # annotations, and recompile observation. False swaps in the
+    # NullRecorder and skips annotation scopes — the metrics REGISTRY
+    # stays on either way (counters are the engine's own bookkeeping and
+    # cost one float add each), so ``metrics()`` is always correct.
+    telemetry: bool = True
+    # Flight-recorder ring capacity (events, not bytes): the newest
+    # trace_ring span/instant events are retained for export; exact
+    # per-name span COUNTS survive wraparound.
+    trace_ring: int = 4096
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -132,6 +144,9 @@ class InferenceConfig:
         if self.spec_ngram < 1:
             raise ValueError("inference.spec_ngram must be >= 1, got "
                              "{}".format(self.spec_ngram))
+        if self.trace_ring < 1:
+            raise ValueError("inference.trace_ring must be >= 1, got "
+                             "{}".format(self.trace_ring))
         if self.spec_decode and not self.chunked_prefill:
             raise ValueError(
                 "inference.spec_decode=True requires chunked_prefill: "

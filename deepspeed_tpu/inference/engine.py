@@ -52,6 +52,7 @@ match, and every program pins its out_shardings so the cache layout
 survives every step. One engine, sharded or not.
 """
 
+import contextlib
 import functools
 import time
 
@@ -65,16 +66,27 @@ from deepspeed_tpu.inference.kv_pool import (
     harvest_snapshot,
     init_pool,
     max_active_frontier,
+    pool_nbytes,
     pool_shardings,
     shard_pool,
 )
 from deepspeed_tpu.inference.scheduler import Scheduler
 from deepspeed_tpu.models import generation
 from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.telemetry import (
+    MetricsRegistry,
+    NullRecorder,
+    RecompileDetector,
+    SpanRecorder,
+    annotate,
+    prometheus_digest,
+    prometheus_text,
+)
 from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
 
 _NEG = None  # set lazily: jnp.finfo(jnp.float32).min
+_NULL_CTX = contextlib.nullcontext()  # reusable & reentrant by contract
 
 
 def _neg():
@@ -120,6 +132,43 @@ def _sample_rows(logits, temp, top_k, seed, position):
     sampled = jax.lax.cond(jnp.any(temp > 0.0), _draw,
                            lambda m: greedy, masked)
     return jnp.where(temp > 0.0, sampled, greedy)
+
+
+class _CounterBank(object):
+    """Dict-shaped view over registry counters: ``bank["tokens_out"] +=
+    n`` keeps the existing call sites (and every external reader of
+    ``engine.counters``) while the values live in the telemetry
+    registry — ONE source of truth for metrics(), Prometheus and
+    TensorBoard. Reads return ints (the public contract); monotonicity
+    is enforced by the underlying Counter."""
+
+    __slots__ = ("_c",)
+
+    def __init__(self, registry, names):
+        self._c = {n: registry.counter(n) for n in names}
+
+    def __getitem__(self, name):
+        return int(self._c[name].value)
+
+    def __setitem__(self, name, value):
+        c = self._c[name]
+        c.inc(value - c.value)
+
+    def __contains__(self, name):
+        return name in self._c
+
+    def __iter__(self):
+        return iter(self._c)
+
+    def keys(self):
+        return self._c.keys()
+
+    def items(self):
+        return [(n, int(c.value)) for n, c in self._c.items()]
+
+    def window(self, name):
+        """Value accumulated since the last metrics(reset=True)."""
+        return int(self._c[name].window_value)
 
 
 # --------------------------------------------------------------- programs
@@ -372,7 +421,19 @@ class InferenceEngine(object):
             use_flash_decode=config.use_flash_decode)
         config.validate_against_model(self._gcfg.n_positions)
         self.mesh = mesh
-        self._scheduler = Scheduler(config.max_slots, config.max_queue)
+
+        # Telemetry. The metrics REGISTRY is always real — counters are
+        # the engine's own bookkeeping (one float add each) and
+        # metrics() must be correct either way. ``telemetry=False``
+        # disables only the optional layers: trace spans (NullRecorder)
+        # and profiler annotations.
+        self.telemetry = MetricsRegistry(engine="inference")
+        self.tracer = (SpanRecorder(capacity=config.trace_ring)
+                       if config.telemetry else NullRecorder())
+        self._scheduler = Scheduler(
+            config.max_slots, config.max_queue,
+            tracer=self.tracer if config.telemetry else None,
+            registry=self.telemetry)
 
         # Engine-lifetime speculation constant: (spec_k, spec_ngram) or
         # None. STATIC — it rides the jit static args, so the spec
@@ -425,19 +486,51 @@ class InferenceEngine(object):
             functools.partial(_mixed_step_program), static_argnums=(1, 2, 3),
             donate_argnums=(4,), out_shardings=mixed_out)
 
-        self.timers = SynchronizedWallClockTimer()
-        self.counters = {
-            "tokens_out": 0, "chunks": 0, "prefills": 0,
-            "prefill_tokens": 0, "requests_completed": 0,
-            "occupied_slot_steps": 0, "slot_steps": 0,
-        }
+        # Recompile detection: the test-only compile_count contract as a
+        # RUNTIME gauge. The mixed program auto-warms after its first
+        # step; the legacy path warms per exercised bucket, so the
+        # caller (bench's A/B warmup) calls mark_warm() explicitly.
+        self.recompile_detector = RecompileDetector(self.telemetry)
+        self.recompile_detector.watch("prefill", self._prefill)
+        self.recompile_detector.watch("decode_chunk", self._decode)
+        self.recompile_detector.watch("mixed_step", self._mixed)
+
+        self.timers = SynchronizedWallClockTimer(registry=self.telemetry)
+        self.counters = _CounterBank(self.telemetry, (
+            "tokens_out", "chunks", "prefills", "prefill_tokens",
+            "requests_completed", "occupied_slot_steps", "slot_steps"))
+        # Live gauges: sampled at read (scrape) time, zero hot-path cost.
+        self.telemetry.gauge("queue_depth").set_fn(
+            lambda: len(self._scheduler.queue))
+        self.telemetry.gauge("slots_running").set_fn(
+            lambda: len(self._scheduler.running))
+        self.telemetry.gauge("slot_occupancy").set_fn(
+            self._scheduler.occupancy)
+        self.telemetry.gauge("kv_pool_bytes").set_fn(
+            lambda: pool_nbytes(self._pool))
+        # Latency histograms (queue_wait_seconds lives in the scheduler;
+        # same registry object — get-or-create is by name).
+        self._ttft_hist = self.telemetry.histogram("ttft_seconds")
+        self._itl_hist = self.telemetry.histogram("inter_token_seconds")
+        self._qwait_hist = self.telemetry.histogram("queue_wait_seconds")
         # accepted-tokens-per-occupied-slot-step histogram (index =
         # count, 1..spec_k+1; index 0 stays empty — an occupied step
         # always emits at least the bonus token). Bounded memory
         # whatever the run length; metrics() derives mean/p50/p99 and
-        # the draft acceptance rate from it.
+        # the draft acceptance rate from it. ``_accept_base`` is the
+        # window floor metrics(reset=True) advances.
         self._accept_hist = np.zeros(config.spec_k + 2, np.int64)
+        self._accept_base = np.zeros_like(self._accept_hist)
         self._t0 = time.time()
+        self._window_t0 = self._t0
+
+    def _annotate(self, name):
+        """Profiler annotation scope, or a free no-op with telemetry
+        off (TraceAnnotation construction is cheap but not free — the
+        off-path must cost nothing)."""
+        if not self.config.telemetry:
+            return _NULL_CTX
+        return annotate(name)
 
     # ------------------------------------------------------------- submit
 
@@ -521,12 +614,37 @@ class InferenceEngine(object):
         harvest, after the device sync — never at dispatch)."""
         req.tokens.append(first)
         req.first_token_time = time.time()
+        self._ttft_hist.observe(req.first_token_time - req.submit_time)
         self.counters["tokens_out"] += 1
         if req.max_new_tokens <= 1 or \
                 (req.eos_token_id >= 0 and first == req.eos_token_id):
-            self._scheduler.complete(req.slot)
-            self.counters["requests_completed"] += 1
-            done.append(req)
+            self._complete(req, done)
+
+    def _complete(self, req, done):
+        """Evict ``req``'s slot and fold its latency into the
+        histograms: the mean inter-token gap per request ((finish -
+        first) / (tokens - 1)) is one observation — the same statistic
+        _latency_percentiles always reported, now windowed."""
+        self._scheduler.complete(req.slot)
+        self.counters["requests_completed"] += 1
+        if req.first_token_time is not None and len(req.tokens) > 1:
+            self._itl_hist.observe(
+                (req.finish_time - req.first_token_time) /
+                (len(req.tokens) - 1))
+        done.append(req)
+
+    def _observe_compiles(self):
+        """Step-boundary recompile check (three int reads). The mixed
+        program warms itself after its first step — its contract is ONE
+        compile ever, so anything later is a recompile worth paging on.
+        The legacy path compiles per exercised prompt bucket and cannot
+        self-warm; callers mark_warm() after their own warmup."""
+        det = self.recompile_detector
+        if not det.warm:
+            if self.config.chunked_prefill and det.total() >= 1:
+                det.mark_warm()
+            return
+        det.observe()
 
     # --------------------------------------------------------------- step
 
@@ -561,18 +679,22 @@ class InferenceEngine(object):
             p_spec = False
 
         self.timers("inference/decode").start()
-        self._pool, first, toks, valid = self._mixed(
-            self._params, self._gcfg, self.config.chunk_size, self._spec,
-            self._pool, jnp.asarray(ids), jnp.int32(slot),
-            jnp.int32(frontier), jnp.int32(n_valid), jnp.asarray(p_done),
-            jnp.asarray(p_spec), jnp.int32(max_new), jnp.int32(eos),
-            jnp.float32(temp), jnp.int32(top_k), jnp.uint32(seed))
+        with self.tracer.timed("step/mixed", prefill_tokens=n_valid), \
+                self._annotate("inference/mixed_step"):
+            self._pool, first, toks, valid = self._mixed(
+                self._params, self._gcfg, self.config.chunk_size, self._spec,
+                self._pool, jnp.asarray(ids), jnp.int32(slot),
+                jnp.int32(frontier), jnp.int32(n_valid), jnp.asarray(p_done),
+                jnp.asarray(p_spec), jnp.int32(max_new), jnp.int32(eos),
+                jnp.float32(temp), jnp.int32(top_k), jnp.uint32(seed))
         # ONE batched host sync per step: tokens, validity, the per-slot
         # scalar snapshot (pos/active/last_tok in a single transfer) and
         # the (possible) first token all land together.
-        toks = np.asarray(toks)
-        valid = np.asarray(valid)
-        snap = harvest_snapshot(self._pool)
+        with self.tracer.timed("step/harvest"), \
+                self._annotate("inference/harvest"):
+            toks = np.asarray(toks)
+            valid = np.asarray(valid)
+            snap = harvest_snapshot(self._pool)
         active = snap["active"]
         self.timers("inference/decode").stop()
         self.counters["chunks"] += 1
@@ -589,6 +711,16 @@ class InferenceEngine(object):
             self._accept_hist += np.bincount(
                 valid.sum(axis=2)[occupied],
                 minlength=self._accept_hist.size)
+            n_occ = int(occupied.sum())
+            if n_occ:
+                # draft/verify/accept summary for this step: n_occ
+                # verifies ran (one per occupied slot-step), each
+                # drafting spec_k tokens; ``accepted`` counts the
+                # emissions they produced (bonus token included).
+                self.tracer.instant(
+                    "spec/verify", verifies=n_occ,
+                    drafted=n_occ * self.config.spec_k,
+                    accepted=int(valid.sum()))
 
         if pf is not None:
             self.counters["prefill_tokens"] += n_valid
@@ -605,33 +737,39 @@ class InferenceEngine(object):
             req.tokens.extend(emitted)
             self.counters["tokens_out"] += len(emitted)
             if not active[slot]:
-                self._scheduler.complete(slot)
-                self.counters["requests_completed"] += 1
-                done.append(req)
+                self._complete(req, done)
+        self._observe_compiles()
         return done
 
     def _step_legacy(self):
         done = []
         admitted = []
         self.timers("inference/prefill").start()
-        for req, slot in self._scheduler.admissions():
-            # Dispatch EVERY prefill before the first host sync: N
-            # admissions pipeline on device instead of paying N
-            # dispatch->int(first) round-trips.
-            admitted.append((req, self._dispatch_prefill(req, slot)))
-        for req, first in admitted:
-            self._scheduler.advance_prefill(req, req.prompt.size)
-            self._harvest_first(req, int(first), done)
+        with self.tracer.timed("step/prefill"), \
+                self._annotate("inference/prefill"):
+            for req, slot in self._scheduler.admissions():
+                # Dispatch EVERY prefill before the first host sync: N
+                # admissions pipeline on device instead of paying N
+                # dispatch->int(first) round-trips.
+                admitted.append((req, self._dispatch_prefill(req, slot)))
+            for req, first in admitted:
+                self._scheduler.advance_prefill(req, req.prompt.size)
+                self._harvest_first(req, int(first), done)
         self.timers("inference/prefill").stop()
 
         if self._scheduler.running:
             self.timers("inference/decode").start()
-            self._pool, toks, valid = self._decode(
-                self._params, self._gcfg, self.config.chunk_size, self._pool)
+            with self.tracer.timed("step/decode"), \
+                    self._annotate("inference/decode_chunk"):
+                self._pool, toks, valid = self._decode(
+                    self._params, self._gcfg, self.config.chunk_size,
+                    self._pool)
             self.timers("inference/decode").stop()
-            toks = np.asarray(toks)
-            valid = np.asarray(valid)
-            active = harvest_snapshot(self._pool)["active"]
+            with self.tracer.timed("step/harvest"), \
+                    self._annotate("inference/harvest"):
+                toks = np.asarray(toks)
+                valid = np.asarray(valid)
+                active = harvest_snapshot(self._pool)["active"]
             self.counters["chunks"] += 1
             self.counters["occupied_slot_steps"] += int(valid.sum())
             self.counters["slot_steps"] += valid.size
@@ -640,9 +778,8 @@ class InferenceEngine(object):
                 req.tokens.extend(emitted)
                 self.counters["tokens_out"] += len(emitted)
                 if not active[slot]:
-                    self._scheduler.complete(slot)
-                    self.counters["requests_completed"] += 1
-                    done.append(req)
+                    self._complete(req, done)
+        self._observe_compiles()
         return done
 
     def run(self, max_steps=None):
@@ -676,57 +813,59 @@ class InferenceEngine(object):
         number the zero-recompile-after-warmup guarantee is asserted on.
         Chunked prefill: 1 after warmup (the mixed step), whatever the
         prompt-length mix. Legacy: 1 decode chunk + one prefill per
-        prompt bucket exercised."""
-        return (self._prefill._cache_size() + self._decode._cache_size() +
-                self._mixed._cache_size())
+        prompt bucket exercised. CUMULATIVE — windows never reset it."""
+        return self.recompile_detector.total()
 
     def _latency_percentiles(self):
-        """TTFT / inter-token / queue-wait percentiles over COMPLETED
-        requests (milliseconds; None before the first completion). The
-        timestamps are the scheduler's: submit -> admit (queue wait),
-        submit -> first harvested token (TTFT), then (finish - first) /
-        (tokens - 1) as the mean inter-token gap per request."""
-        ttft, qwait, itl = [], [], []
-        for r in self._scheduler.completed.values():
-            if r.admit_time is not None:
-                qwait.append(r.admit_time - r.submit_time)
-            if r.first_token_time is not None:
-                ttft.append(r.first_token_time - r.submit_time)
-                if r.finish_time is not None and len(r.tokens) > 1:
-                    itl.append((r.finish_time - r.first_token_time) /
-                               (len(r.tokens) - 1))
-
-        def pct(xs, p):
-            return round(float(np.percentile(xs, p)) * 1e3, 3) if xs else None
+        """TTFT / inter-token / queue-wait percentiles (milliseconds;
+        None before the first observation) out of the registry's
+        bounded-reservoir histograms — windowed like everything else in
+        metrics(), and the same series Prometheus exports as summary
+        quantiles. TTFT is submit -> first harvested token; queue wait
+        submit -> admit; inter-token the mean gap per completed request
+        ((finish - first) / (tokens - 1))."""
+        def pct(h, p):
+            v = h.percentile(p)
+            return round(v * 1e3, 3) if v is not None else None
 
         return {
-            "ttft_p50_ms": pct(ttft, 50),
-            "ttft_p99_ms": pct(ttft, 99),
-            "inter_token_p50_ms": pct(itl, 50),
-            "inter_token_p99_ms": pct(itl, 99),
-            "queue_wait_p50_ms": pct(qwait, 50),
-            "queue_wait_p99_ms": pct(qwait, 99),
+            "ttft_p50_ms": pct(self._ttft_hist, 50),
+            "ttft_p99_ms": pct(self._ttft_hist, 99),
+            "inter_token_p50_ms": pct(self._itl_hist, 50),
+            "inter_token_p99_ms": pct(self._itl_hist, 99),
+            "queue_wait_p50_ms": pct(self._qwait_hist, 50),
+            "queue_wait_p99_ms": pct(self._qwait_hist, 99),
         }
 
-    def metrics(self):
-        wall = max(time.time() - self._t0, 1e-9)
+    def metrics(self, reset=False):
+        """Serving metrics snapshot. ``reset=False`` (the default, and
+        the historical behavior) reads since engine construction.
+        ``reset=True`` additionally OPENS A NEW WINDOW after reading:
+        counters, latency/phase histograms, spec accept stats and the
+        wall clock all restart, so two successive metrics(reset=True)
+        calls bracket exactly the work between them — how bench's A/B
+        phases isolate warmup from the measured run. ``compile_count``
+        and ``recompiles`` are cumulative facts and never reset."""
+        now = time.time()
+        wall = max(now - self._window_t0, 1e-9)
         c = self.counters
         m = {
-            "tokens_out": c["tokens_out"],
-            "requests_completed": c["requests_completed"],
-            "prefills": c["prefills"],
-            "prefill_tokens": c["prefill_tokens"],
-            "chunks": c["chunks"],
-            "tokens_per_sec": c["tokens_out"] / wall,
-            "slot_occupancy": (c["occupied_slot_steps"] /
-                               max(c["slot_steps"], 1)),
+            "tokens_out": c.window("tokens_out"),
+            "requests_completed": c.window("requests_completed"),
+            "prefills": c.window("prefills"),
+            "prefill_tokens": c.window("prefill_tokens"),
+            "chunks": c.window("chunks"),
+            "tokens_per_sec": c.window("tokens_out") / wall,
+            "slot_occupancy": (c.window("occupied_slot_steps") /
+                               max(c.window("slot_steps"), 1)),
             "queue_depth": len(self._scheduler.queue),
             "running": len(self._scheduler.running),
             "compile_count": self.compile_count,
+            "recompiles": int(self.recompile_detector.recompiles.value),
             "prefill_seconds": self.timers(
-                "inference/prefill").elapsed(reset=False),
+                "inference/prefill").elapsed(reset=reset),
             "decode_seconds": self.timers(
-                "inference/decode").elapsed(reset=False),
+                "inference/decode").elapsed(reset=reset),
             "flash_decode": bool(self._gcfg.use_flash_decode),
             "chunked_prefill": bool(self.config.chunked_prefill),
             "prefill_chunk": self.config.prefill_chunk,
@@ -734,7 +873,7 @@ class InferenceEngine(object):
             "spec_decode": self._spec is not None,
         }
         if self._spec is not None:
-            hist = self._accept_hist
+            hist = self._accept_hist - self._accept_base
             n = int(hist.sum())
             # Expand the bounded histogram back to per-step samples for
             # exact percentiles (n = occupied slot-steps; tiny next to
@@ -757,4 +896,38 @@ class InferenceEngine(object):
                           4) if n else None),
             })
         m.update(self._latency_percentiles())
+        if reset:
+            self.telemetry.reset_window()
+            self._accept_base = self._accept_hist.copy()
+            self._window_t0 = now
         return m
+
+    # ---------------------------------------------------------- telemetry
+
+    def prometheus(self):
+        """Prometheus text-exposition snapshot of this engine's
+        registry (exporters.prometheus_text). Serve it with
+        telemetry.PrometheusEndpoint(engine.telemetry) — never opened
+        implicitly."""
+        return prometheus_text(self.telemetry)
+
+    def telemetry_snapshot(self):
+        """The compact observability fingerprint bench stamps into its
+        JSON: the Prometheus snapshot's sha256 + sample-line count,
+        exact per-name span counts (ring-wrap-proof), and the
+        cumulative compile/recompile facts."""
+        sha, lines = prometheus_digest(self.telemetry)
+        return {
+            "prometheus_sha256": sha,
+            "prometheus_lines": lines,
+            "span_counts": self.tracer.span_counts(),
+            "spans_dropped": self.tracer.dropped,
+            "compile_count": self.compile_count,
+            "recompiles": int(self.recompile_detector.recompiles.value),
+        }
+
+    def write_trace(self, path):
+        """Dump the flight ring as a Chrome trace-event JSON file
+        (Perfetto / chrome://tracing loadable). Raises when telemetry
+        is off — an empty file would read as 'nothing happened'."""
+        return self.tracer.write_chrome_trace(path)

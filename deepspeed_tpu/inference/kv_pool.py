@@ -162,6 +162,15 @@ def max_active_frontier(pool, snap=None):
     return int((pos * active).max()) if pos.size else 0
 
 
+def pool_nbytes(pool):
+    """Total device bytes held by the pool (k/v planes dominate; the
+    per-slot scalars and the token ring are noise). The telemetry
+    ``kv_pool_bytes`` gauge reads this — it is a static fact of the
+    compiled shapes, so one number describes the whole run."""
+    return int(sum(getattr(leaf, "nbytes", 0)
+                   for leaf in jax.tree_util.tree_leaves(pool)))
+
+
 def cache_view(pool):
     """The pool's k/v/pos as a ``models.generation`` cache dict — the
     decode step program consumes the pool's slots directly as batch rows."""

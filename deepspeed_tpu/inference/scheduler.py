@@ -17,6 +17,12 @@ path passes through ``prefilling`` for exactly one engine step.
 
 Timestamps are stamped here (submit / admit / first token / finish) so
 the serving benchmark and the engine's metrics read one source of truth.
+The optional ``tracer`` (telemetry.SpanRecorder) turns those same
+timestamps into per-request Chrome trace spans — each request rides its
+own track (tid=rid): a ``request/queued`` span (submit -> admit), a
+``request/prefill`` span (admit -> first token sampled), a
+``request/decode`` span (first token -> finish) and a whole-lifetime
+``request`` span, with ``request/cancelled`` instants for evictions.
 """
 
 import collections
@@ -72,13 +78,19 @@ class Request(object):
 class Scheduler(object):
     """FIFO admission over a fixed slot set."""
 
-    def __init__(self, num_slots, max_queue):
+    def __init__(self, num_slots, max_queue, tracer=None, registry=None):
         self.num_slots = num_slots
         self.max_queue = max_queue
         self.queue = collections.deque()
         self.running = {}           # slot -> Request (prefilling | decoding)
         self.completed = {}         # rid -> Request (incl. cancelled)
         self._ids = itertools.count()
+        # Telemetry is strictly additive: tracer gets lifecycle spans,
+        # registry gets the queue-wait histogram. Both optional — a bare
+        # Scheduler(num_slots, max_queue) behaves exactly as before.
+        self.tracer = tracer
+        self._queue_wait = (registry.histogram("queue_wait_seconds")
+                            if registry is not None else None)
 
     # ------------------------------------------------------------ submit
 
@@ -115,6 +127,13 @@ class Scheduler(object):
             req.admit_time = time.time()
             self.running[slot] = req
             pairs.append((req, slot))
+            if self._queue_wait is not None:
+                self._queue_wait.observe(req.admit_time - req.submit_time)
+            if self.tracer is not None:
+                self.tracer.span("request/queued", req.submit_time,
+                                 req.admit_time, tid=req.rid,
+                                 rid=req.rid, slot=slot,
+                                 prompt_tokens=int(req.prompt.size))
         return pairs
 
     # ----------------------------------------------------------- prefill
@@ -134,6 +153,10 @@ class Scheduler(object):
         req.cursor += n
         if req.cursor >= req.prompt.size:
             req.phase = "decoding"
+            if self.tracer is not None:
+                self.tracer.span("request/prefill", req.admit_time,
+                                 tid=req.rid, rid=req.rid, slot=req.slot,
+                                 prompt_tokens=int(req.prompt.size))
             return True
         return False
 
@@ -147,6 +170,14 @@ class Scheduler(object):
         req.phase = "done"
         req.slot = None
         self.completed[req.rid] = req
+        if self.tracer is not None:
+            if req.first_token_time is not None:
+                self.tracer.span("request/decode", req.first_token_time,
+                                 req.finish_time, tid=req.rid, rid=req.rid,
+                                 tokens=len(req.tokens))
+            self.tracer.span("request", req.submit_time, req.finish_time,
+                             tid=req.rid, rid=req.rid,
+                             tokens=len(req.tokens), phase="done")
         return req
 
     def cancel(self, req):
@@ -167,6 +198,12 @@ class Scheduler(object):
         req.phase = "cancelled"
         req.finish_time = time.time()
         self.completed[req.rid] = req
+        if self.tracer is not None:
+            self.tracer.instant("request/cancelled", tid=req.rid,
+                                rid=req.rid, tokens=len(req.tokens))
+            self.tracer.span("request", req.submit_time, req.finish_time,
+                             tid=req.rid, rid=req.rid,
+                             tokens=len(req.tokens), phase="cancelled")
         return True
 
     @property

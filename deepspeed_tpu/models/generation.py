@@ -339,6 +339,8 @@ def generate(model, params, prompt_ids, max_new_tokens, temperature=1.0,
     Returns [B, max_new_tokens] int32. Rows that emit ``eos_token_id``
     keep repeating it (fixed-length output; trim host-side).
     """
+    from deepspeed_tpu.telemetry import annotate
+
     cfg = as_gencfg(getattr(model, "config", model))
     assert max_new_tokens >= 1
     if rng is None:
@@ -346,5 +348,8 @@ def generate(model, params, prompt_ids, max_new_tokens, temperature=1.0,
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     assert prompt_ids.shape[1] + max_new_tokens <= cfg.n_positions, \
         "prompt + new tokens exceed n_positions={}".format(cfg.n_positions)
-    return _generate_jit(params, cfg, prompt_ids, int(max_new_tokens),
-                         float(temperature), top_k, rng, eos_token_id)
+    # Host-side profiler scope around the whole-batch dispatch: shows up
+    # as one "generation.generate" block on a DS_TPU_PROFILE_DIR capture.
+    with annotate("generation.generate"):
+        return _generate_jit(params, cfg, prompt_ids, int(max_new_tokens),
+                             float(temperature), top_k, rng, eos_token_id)

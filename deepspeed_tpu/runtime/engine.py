@@ -59,6 +59,7 @@ from deepspeed_tpu.runtime.utils import (
     jit_has_overflow,
 )
 from deepspeed_tpu.runtime.utils import global_norm as utils_global_norm
+from deepspeed_tpu.telemetry import MetricsRegistry, TensorBoardScalarWriter
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 
@@ -200,12 +201,29 @@ class DeepSpeedEngine(object):
         else:
             self.compute_dtype = jnp.float32
 
-        self.timers = SynchronizedWallClockTimer()
+        # Telemetry registry (telemetry/): the wall_clock_breakdown
+        # timers observe their phase durations into it as timer_seconds
+        # histograms, the throughput timer exposes a live
+        # samples_per_sec gauge, and the step/sample/lr trackers below
+        # read the engine's own state at scrape time. Exporters
+        # (Prometheus text, the TensorBoard scalar writer behind the
+        # tensorboard_* config keys) read the same registry.
+        self.telemetry = MetricsRegistry(engine="training")
+        self.timers = SynchronizedWallClockTimer(registry=self.telemetry)
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_micro_batch_size_per_gpu(),
             num_workers=self.dp_world_size,
             steps_per_output=self.steps_per_print(),
-            monitor_memory=False)
+            monitor_memory=False,
+            registry=self.telemetry)
+        self.telemetry.gauge("global_steps").set_fn(
+            lambda: self.global_steps)
+        self.telemetry.gauge("global_samples").set_fn(
+            lambda: self.global_samples)
+        self.telemetry.gauge("skipped_steps").set_fn(
+            lambda: self.skipped_steps)
+        self.telemetry.gauge("lr").set_fn(
+            lambda: (self.get_lr() if self.optimizer else [0.0])[0])
 
         self.training_dataloader = self.deepspeed_io(training_data) \
             if training_data else None
@@ -230,10 +248,11 @@ class DeepSpeedEngine(object):
 
         self._configure_checkpointing()
 
-        # TensorBoard monitor (reference engine.py:149-150).
-        self._summary_writer = None
+        # TensorBoard monitor (reference engine.py:149-150), now a
+        # telemetry.TensorBoardScalarWriter (lazy; warn-once no-op when
+        # the extra is missing).
+        self._tb_writer = None
         self._last_loss = None
-        self.warn_tensorboard = True
 
         # Jitted program caches, keyed by static call signature.
         self._fwd_bwd_cache = {}
@@ -467,55 +486,67 @@ class DeepSpeedEngine(object):
     def tensorboard_job_name(self):
         return self._config.tensorboard_job_name
 
-    def get_summary_writer(self, name="DeepSpeedJobName", base=None):
-        """Lazy SummaryWriter (reference engine.py:247-272): events under
-        <output_path>/<job_name> or $DLWS/DLTS job dirs."""
-        if self._summary_writer is not None:
-            return self._summary_writer
-        from torch.utils.tensorboard import SummaryWriter
+    def _tensorboard_log_dir(self, name="DeepSpeedJobName", base=None):
+        """Event-file directory (reference engine.py:247-272): under
+        <output_path>/<job_name>, or the $DLWS/DLTS job dirs."""
         if self.tensorboard_output_path():
-            base_dir = self.tensorboard_output_path()
-            name = self.tensorboard_job_name() or name
-            log_dir = os.path.join(base_dir, name)
+            return os.path.join(self.tensorboard_output_path(),
+                                self.tensorboard_job_name() or name)
+        summary_writer_dir_name = (self.tensorboard_job_name() or name)
+        if base is None:
+            base = os.path.join(os.path.expanduser("~"), "tensorboard")
+        if "DLWS_JOB_ID" in os.environ:
+            infra_job_id = os.environ["DLWS_JOB_ID"]
+        elif "DLTS_JOB_ID" in os.environ:
+            infra_job_id = os.environ["DLTS_JOB_ID"]
         else:
-            summary_writer_dir_name = (self.tensorboard_job_name() or name)
-            if base is None:
-                base = os.path.join(os.path.expanduser("~"), "tensorboard")
-            if "DLWS_JOB_ID" in os.environ:
-                infra_job_id = os.environ["DLWS_JOB_ID"]
-            elif "DLTS_JOB_ID" in os.environ:
-                infra_job_id = os.environ["DLTS_JOB_ID"]
-            else:
-                infra_job_id = "unknown-job-id"
-            log_dir = os.path.join(base, infra_job_id, summary_writer_dir_name)
-        os.makedirs(log_dir, exist_ok=True)
-        self._summary_writer = SummaryWriter(log_dir=log_dir)
-        return self._summary_writer
+            infra_job_id = "unknown-job-id"
+        return os.path.join(base, infra_job_id, summary_writer_dir_name)
+
+    def _scalar_writer(self, name="DeepSpeedJobName", base=None):
+        """Lazy telemetry.TensorBoardScalarWriter behind the
+        ``tensorboard_*`` config keys. Degrades to a warn-once no-op
+        when the tensorboard extra is missing — training never crashes
+        over an exporter."""
+        if self._tb_writer is None:
+            self._tb_writer = TensorBoardScalarWriter(
+                self._tensorboard_log_dir(name=name, base=base))
+        return self._tb_writer
+
+    def get_summary_writer(self, name="DeepSpeedJobName", base=None):
+        """The raw SummaryWriter (reference API); raises when the
+        tensorboard extra is unavailable — callers who can proceed
+        without it should go through ``_scalar_writer()`` instead."""
+        writer = self._scalar_writer(name=name, base=base)._get()
+        if writer is None:
+            raise RuntimeError(
+                "tensorboard is unavailable (torch.utils.tensorboard "
+                "failed to import or the log dir is unwritable)")
+        return writer
 
     def _tensorboard_step_events(self):
         """Per-step scalars (reference engine.py:1011-1025: Train/Samples/
-        train_loss, lr, loss_scale at each boundary step)."""
+        train_loss, lr, loss_scale at each boundary step), plus the
+        telemetry registry snapshot (phase-timer percentiles,
+        samples_per_sec, step/sample gauges) under ``telemetry/``."""
         if not self.tensorboard_enabled() or self.global_rank != 0:
             return
-        try:
-            writer = self.get_summary_writer()
-        except Exception as e:  # tensorboard missing/unwritable: warn once
-            if self.warn_tensorboard:
-                logger.warning("tensorboard disabled: %s", e)
-                self.warn_tensorboard = False
+        tb = self._scalar_writer()
+        if not tb.available:  # warned once inside the writer
             return
         if self._last_loss is not None:
-            writer.add_scalar("Train/Samples/train_loss",
-                              float(jax.device_get(self._last_loss)),
-                              self.global_samples)
+            tb.add_scalar("Train/Samples/train_loss",
+                          float(jax.device_get(self._last_loss)),
+                          self.global_samples)
         if self.optimizer is not None:
-            writer.add_scalar("Train/Samples/lr", self.get_lr()[0],
-                              self.global_samples)
+            tb.add_scalar("Train/Samples/lr", self.get_lr()[0],
+                          self.global_samples)
         if self.loss_scaler is not None:
-            writer.add_scalar("Train/Samples/loss_scale",
-                              self.loss_scaler.loss_scale,
-                              self.global_samples)
-        writer.flush()
+            tb.add_scalar("Train/Samples/loss_scale",
+                          self.loss_scaler.loss_scale,
+                          self.global_samples)
+        tb.publish(self.telemetry, self.global_samples)
+        tb.flush()
 
     def pld_enabled(self):
         return self._config.pld_enabled
@@ -1813,10 +1844,18 @@ class DeepSpeedEngine(object):
         self.micro_steps += 1
 
     def _report_progress(self, step):
+        """The ``steps_per_print`` line, fed from the telemetry registry:
+        the same gauges Prometheus/TensorBoard export, so the printed
+        step log and the scraped metrics can never disagree."""
         lr = self.get_lr() if self.optimizer else [0.0]
         mom = self.get_mom() if self.optimizer else [0.0]
-        log_dist("step={}, skipped={}, lr={}, mom={}".format(
-            step, self.skipped_steps, lr, mom), ranks=[0])
+        snap = self.telemetry.snapshot()
+        log_dist(
+            "step={}, skipped={}, lr={}, mom={}, samples={}, "
+            "samples/sec={:.2f}".format(
+                step, self.skipped_steps, lr, mom,
+                int(snap.get("global_samples", 0)),
+                snap.get("samples_per_sec", 0.0)), ranks=[0])
 
     # --------------------------------------------------------- fused fast path
 

@@ -1,0 +1,58 @@
+"""deepspeed_tpu.telemetry — unified observability for training + serving.
+
+One dependency-free subsystem every engine emits into:
+
+- ``MetricsRegistry`` (registry.py): counters / gauges /
+  bounded-reservoir histograms with windowed snapshots.
+- ``SpanRecorder`` (tracing.py): per-request trace spans exported as
+  Chrome trace-event JSON (Perfetto-loadable) and a JSONL flight ring.
+- ``RecompileDetector`` / ``annotate`` / ``profile_window``
+  (instrumentation.py): jit cache-miss detection as a live gauge,
+  ``jax.profiler.TraceAnnotation`` scoping, and the
+  ``DS_TPU_PROFILE_DIR``-gated capture window.
+- ``prometheus_text`` / ``PrometheusEndpoint`` /
+  ``TensorBoardScalarWriter`` (exporters.py): the read-side. The
+  tensorboard extra is imported lazily — this package imports clean on
+  a bare interpreter.
+
+See docs/OBSERVABILITY.md for the full contract.
+"""
+
+from deepspeed_tpu.telemetry.exporters import (
+    PrometheusEndpoint,
+    TensorBoardScalarWriter,
+    prometheus_digest,
+    prometheus_text,
+)
+from deepspeed_tpu.telemetry.instrumentation import (
+    PROFILE_DIR_ENV,
+    RecompileDetector,
+    annotate,
+    profile_window,
+)
+from deepspeed_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    NullRegistry,
+)
+from deepspeed_tpu.telemetry.tracing import NullRecorder, SpanRecorder
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "NullRegistry",
+    "NullRecorder",
+    "SpanRecorder",
+    "RecompileDetector",
+    "annotate",
+    "profile_window",
+    "PROFILE_DIR_ENV",
+    "prometheus_text",
+    "prometheus_digest",
+    "PrometheusEndpoint",
+    "TensorBoardScalarWriter",
+]

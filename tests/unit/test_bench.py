@@ -384,3 +384,97 @@ def test_serving_smoke_measures_in_process(bench):
     assert 0.0 < e["slot_occupancy"] <= 1.0
     assert e["p50_per_token_latency_ms"] <= e["p99_per_token_latency_ms"]
     json.dumps(r)  # driver-facing line must be JSON-serializable
+
+
+def test_probe_records_attempt_diagnostics(bench, monkeypatch):
+    """Every probe attempt leaves a diagnostic row — attempt number, the
+    timeout it ran with, how long it actually took, and the error (None
+    on the success row) — so a fallback JSON can show WHY the run came
+    up on CPU instead of a bare "fallback" flag."""
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    clock = FakeClock()
+    monkeypatch.setattr(bench.time, "time", clock.time)
+    n = [0]
+
+    def probe(timeout):
+        clock.t += 10
+        n[0] += 1
+        return (n[0] >= 3), "relay wedged"
+
+    assert bench._device_probe(budget=480, probe=probe, sleep=clock.sleep)
+    rows = bench._PROBE_ATTEMPTS
+    assert [r["attempt"] for r in rows] == [1, 2, 3]
+    assert [r["error"] for r in rows] == ["relay wedged", "relay wedged",
+                                         None]
+    for r in rows:
+        assert r["timeout_s"] > 0 and r["elapsed_s"] == 10
+
+
+def test_emit_fallback_attaches_probe_attempts(bench, monkeypatch, capsys):
+    monkeypatch.setenv("DS_BENCH_FALLBACK", "accelerator-init-failed")
+    bench._PROBE_ATTEMPTS.extend([
+        {"attempt": 1, "timeout_s": 45.0, "elapsed_s": 45.2,
+         "error": "timeout"},
+        {"attempt": 2, "timeout_s": 180.0, "elapsed_s": 0.4,
+         "error": None},
+    ])
+    bench._emit({"metric": "m", "value": 1.0, "unit": "u",
+                 "vs_baseline": 1.0, "extra": {"platform": "cpu"}})
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["extra"]["probe_attempts"] == bench._PROBE_ATTEMPTS
+
+
+def test_emit_without_fallback_has_no_probe_attempts(bench, monkeypatch,
+                                                     tmp_path, capsys):
+    # A healthy TPU run must not carry probe noise even when earlier
+    # attempts were recorded (e.g. a retry that then succeeded).
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH",
+                        str(tmp_path / "last_good_tpu.json"))
+    bench._PROBE_ATTEMPTS.append(
+        {"attempt": 1, "timeout_s": 45.0, "elapsed_s": 1.0, "error": None})
+    bench._emit({"metric": "m", "value": 1.0, "unit": "u",
+                 "vs_baseline": 1.0, "extra": {"platform": "tpu"}})
+    out = json.loads(capsys.readouterr().out.strip())
+    assert "probe_attempts" not in out["extra"]
+    assert "fallback" not in out["extra"]
+
+
+def test_emit_fallback_stale_hash_suppresses_ratio(bench, monkeypatch,
+                                                   tmp_path, capsys):
+    """A PROVABLY stale last-good artifact (different commit) must not
+    surface as the headline vs_baseline: the ratio is nulled with an
+    explicit suppression note, while the full stale record stays under
+    extra for a human to weigh."""
+    last = {"metric": "m", "value": 44955.0, "unit": "tok/s",
+            "vs_baseline": 1.0005,
+            "extra": {"platform": "tpu", "git_hash": "someoldcommit"}}
+    p = tmp_path / "last_good_tpu.json"
+    p.write_text(json.dumps({"m": last}))
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(p))
+    monkeypatch.setenv("DS_BENCH_FALLBACK", "accelerator-init-failed")
+
+    bench._emit({"metric": "m", "value": 100.0, "unit": "tok/s",
+                 "vs_baseline": 0.02, "extra": {"platform": "cpu"}})
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["extra"]["last_good_stale_hash"] is True
+    assert out["vs_baseline"] is None
+    assert "stale" in out["extra"]["vs_baseline_suppressed"]
+    assert out["extra"]["last_good_tpu"]["value"] == 44955.0  # kept
+
+
+def test_serving_smoke_carries_telemetry_snapshot(bench):
+    """The --serve JSON embeds the telemetry snapshot: a Prometheus text
+    fingerprint plus exact span counts — enough for a reviewer to tell
+    two runs exported the same metric/span shapes without the full text."""
+    r = bench._measure_serving(smoke=True)
+    t = r["extra"]["telemetry"]
+    assert len(t["prometheus_sha256"]) == 64
+    assert t["prometheus_lines"] > 0
+    assert t["recompiles"] == 0 and t["compile_count"] >= 1
+    counts = t["span_counts"]
+    # Counts are exact since engine construction, so warmup requests
+    # (one per distinct prompt length) ride along with the timed stream.
+    assert counts["request"] >= r["extra"]["requests"]
+    assert counts["request/queued"] == counts["request"]
+    assert counts.get("step/mixed", 0) > 0
+    json.dumps(r)

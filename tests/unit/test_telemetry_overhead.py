@@ -1,0 +1,100 @@
+"""Telemetry overhead guard — observability must be ~free on the hot path.
+
+The contract under test:
+1. NO RECOMPILES — telemetry on vs off runs the IDENTICAL compiled
+   program set: same compile_count, zero post-warmup recompiles either
+   way (annotations and spans are host-side; nothing telemetry does may
+   perturb tracing).
+2. HOST OVERHEAD — the per-step host cost with spans + annotations +
+   registry enabled stays within 5% of telemetry-off on the CPU tier-1
+   path, measured as min-of-N over repeated identical step loops (min
+   discards scheduler noise; both sides run warm).
+"""
+
+import time
+
+import pytest
+
+from tests.unit.test_chunked_prefill import (
+    engine_of,
+    make_model,
+    prompts_of,
+)
+
+
+def _steady_engine(model, params, telemetry):
+    """A warmed engine holding one slot mid-decode: each step() is then
+    a pure decode step of the compiled mixed program — the hot path the
+    overhead bound is about."""
+    eng = engine_of(model, params, telemetry=telemetry, max_slots=2)
+    eng.generate([prompts_of(make_model()[0], [5])[0]],
+                 max_new_tokens=2)  # warmup: compile + first harvest
+    return eng
+
+
+def _one_run(eng, prompt, steps):
+    """Seconds for ``steps`` decode steps at steady state."""
+    r = eng.submit(prompt, max_new_tokens=steps + 2)
+    eng.step()  # prefill + first token: outside the timed window
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        eng.step()
+    dt = time.perf_counter() - t0
+    while not r.done:
+        eng.step()
+    return dt
+
+
+def test_telemetry_adds_no_recompiles_and_bounded_host_overhead():
+    cfg, model, params = make_model()
+    prompt = prompts_of(cfg, [6])[0]
+
+    on = _steady_engine(model, params, telemetry=True)
+    off = _steady_engine(model, params, telemetry=False)
+    assert on.compile_count == off.compile_count == 1
+
+    # Interleaved min-of-N: alternating on/off runs exposes both sides
+    # to the same machine-wide noise; min discards scheduler hiccups.
+    _one_run(on, prompt, steps=12)   # loop warmup, untimed
+    _one_run(off, prompt, steps=12)
+    t_on = t_off = float("inf")
+    for _ in range(8):
+        t_on = min(t_on, _one_run(on, prompt, steps=12))
+        t_off = min(t_off, _one_run(off, prompt, steps=12))
+
+    # Identical program set, still zero recompiles after the timed runs.
+    assert on.compile_count == off.compile_count == 1
+    assert on.metrics()["recompiles"] == 0
+    assert off.metrics()["recompiles"] == 0
+
+    # Host overhead bound. The tiny-model CPU step is dominated by jit
+    # dispatch (~ms); spans/annotations must stay in the noise. 5% is
+    # the budget the ISSUE sets; measured slack is far larger in
+    # practice, and min-of-N keeps CI machines from flaking it.
+    assert t_on <= t_off * 1.05, (
+        "telemetry-on steps {:.4f}s vs off {:.4f}s (> +5%)".format(
+            t_on, t_off))
+
+    # The on-engine actually recorded: the comparison was not no-op
+    # against no-op.
+    counts = on.tracer.span_counts()
+    assert counts.get("step/mixed", 0) > 0
+    assert off.tracer.span_counts() == {}
+
+
+def test_telemetry_import_is_extras_free():
+    """Belt-and-braces for CI images without optional extras: the
+    telemetry package import must not pull tensorboard or any exporter
+    dependency at module-load time (the deep check — subprocess with
+    blocked modules — lives in test_telemetry.py)."""
+    import importlib
+
+    import deepspeed_tpu.telemetry as t
+
+    importlib.reload(t)  # module-load path runs clean with no extras
+    reg = t.MetricsRegistry()
+    reg.counter("ok").inc(1)
+    assert "ds_tpu_ok_total 1" in t.prometheus_text(reg)
+    # TensorBoard is lazy: constructing the writer must not import it.
+    w = t.TensorBoardScalarWriter("/tmp/never-used")
+    assert w._writer is None and w._dead is False
