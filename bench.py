@@ -32,6 +32,21 @@ LAST_GOOD_PATH = os.path.join(
 # instead of one "gave up" stderr line that the driver never captures.
 _PROBE_ATTEMPTS = []
 
+# Probe verdict for this PROCESS: None (never ran), "probed" (paid a
+# subprocess init and saw the chip), "cached" (an earlier success in
+# this process stands — backend init is expensive and a chip that
+# initialized once is not re-litigated within one supervisor run), or
+# "skipped" (DS_TPU_BENCH_ASSUME_TPU=1 told us not to ask). _emit stamps
+# it so the JSON says how the platform claim was established.
+_PROBE_STATE = None
+
+# Operator escape hatch: the driver already KNOWS the chip is healthy
+# (just probed it out-of-band, or is iterating on a box where the 45 s
+# subprocess probe is pure overhead) — skip the probe entirely and trust
+# the environment. The emitted JSON carries probe="skipped" so a reader
+# can tell a trusted claim from a measured one.
+ASSUME_TPU_ENV = "DS_TPU_BENCH_ASSUME_TPU"
+
 
 def _git_state():
     """Short commit hash of the measured code, '-dirty'-suffixed when the
@@ -95,9 +110,21 @@ def _device_probe(budget=480, attempt_timeout=None, probe=_probe_once,
     (tests) also overrides both.
 
     Only runs in the tunneled-relay environment (PALLAS_AXON_POOL_IPS):
-    a healthy deployment should not pay backend init twice."""
+    a healthy deployment should not pay backend init twice. A SUCCESSFUL
+    probe is cached for the process lifetime (``_PROBE_STATE``) — multi-
+    stage runs (battery, sweep, saturation) pay backend init once, not
+    per stage; failures are never cached (a wedge can clear).
+    ``DS_TPU_BENCH_ASSUME_TPU=1`` skips the probe entirely and the
+    emitted JSON says ``probe: skipped``."""
+    global _PROBE_STATE
+    if os.environ.get(ASSUME_TPU_ENV, "0") not in ("0", "", "false"):
+        _PROBE_STATE = "skipped"
+        return True
     if os.environ.get("JAX_PLATFORMS", "") == "cpu" or \
             not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return True
+    if _PROBE_STATE in ("probed", "cached"):
+        _PROBE_STATE = "cached"
         return True
     env_t = os.environ.get("DS_TPU_BENCH_PROBE_TIMEOUT")
     if attempt_timeout is not None:
@@ -129,6 +156,7 @@ def _device_probe(budget=480, attempt_timeout=None, probe=_probe_once,
             "error": None if ok else reason,
         })
         if ok:
+            _PROBE_STATE = "probed"
             return True
         print("bench: accelerator probe attempt {} failed ({})".format(
             attempt, reason), file=sys.stderr)
@@ -280,6 +308,8 @@ _FALLBACK_METRIC_FOR = {
         "bert_large_sparse_tokens_per_sec_per_chip",
     "gpt2_tiny_serving_tokens_per_sec":
         "gpt2_355m_serving_tokens_per_sec",
+    "gpt2_tiny_smoke_sustained_goodput_tokens_per_sec_per_chip":
+        "gpt2_355m_sustained_goodput_tokens_per_sec_per_chip",
 }
 
 
@@ -291,6 +321,13 @@ def _emit(result):
     exists to keep the harness alive through a wedged relay, not to
     report a 40x 'regression' that is really a dead tunnel."""
     result["extra"].setdefault("git_hash", _git_state())
+    # How the platform claim was established. The env check covers the
+    # inner subprocess (which inherits the supervisor's environment but
+    # not its _PROBE_STATE global); the global covers in-process runs.
+    if os.environ.get(ASSUME_TPU_ENV, "0") not in ("0", "", "false"):
+        result["extra"].setdefault("probe", "skipped")
+    elif _PROBE_STATE is not None:
+        result["extra"].setdefault("probe", _PROBE_STATE)
     fallback = os.environ.get("DS_BENCH_FALLBACK")
     if fallback:
         result["extra"]["fallback"] = fallback
@@ -368,12 +405,19 @@ def _timed_chunks(step_fn, batches, chunk, tokens_per_step, label):
     One end-of-run barrier would leave NO evidence if the tunneled dev
     TPU's relay wedges mid-run; per-chunk timing also lets the headline
     exclude tunnel stalls (a wedge inflates one chunk, not all). Returns
-    (chunk_rates tok/s/chip, last_loss); the headline rate is
-    max(chunk_rates), the honest device-limited number.
+    (chunk_log, last_loss): one dict per chunk — rate (tok/s/chip),
+    steps, dt_s, and the backend that executed THAT chunk. Per-chunk
+    platform provenance matters because the supervisor can fall back to
+    CPU mid-battery: a log whose chunks all say the same backend proves
+    the headline was measured on one platform end to end. The headline
+    rate is max of the rates, the honest device-limited number.
 
     step_fn(batch) must return the step's loss (device scalar); float()
     on it is the barrier."""
-    chunk_rates = []
+    import jax
+
+    platform = jax.default_backend()
+    chunk_log = []
     loss_val = None
     i = 0
     while i < len(batches):
@@ -384,12 +428,16 @@ def _timed_chunks(step_fn, batches, chunk, tokens_per_step, label):
         loss_val = float(loss)
         dt = time.time() - t0
         rate = tokens_per_step * len(ids_chunk) / dt
-        chunk_rates.append(round(rate, 1))
+        chunk_log.append({"rate": round(rate, 1),
+                          "steps": len(ids_chunk),
+                          "dt_s": round(dt, 4),
+                          "platform": platform})
         print("bench: {} chunk {} steps in {:.3f}s -> {:.0f} "
-              "tok/s/chip".format(label, len(ids_chunk), dt, rate),
+              "tok/s/chip [{}]".format(label, len(ids_chunk), dt, rate,
+                                       platform),
               file=sys.stderr, flush=True)
         i += chunk
-    return chunk_rates, loss_val
+    return chunk_log, loss_val
 
 
 def flops_per_token(cfg, seq):
@@ -522,9 +570,10 @@ def main_xl_compute():
     loss, _ = grad_fn(params, batches[0])
     float(loss)  # compile + warm (scalar fetch is the reliable barrier)
 
-    chunk_rates, loss = _timed_chunks(
+    chunk_log, loss = _timed_chunks(
         lambda ids: grad_fn(params, ids)[0], batches[1:],
         chunk=4, tokens_per_step=batch * seq, label="xl-compute")
+    chunk_rates = [c["rate"] for c in chunk_log]
     tok = max(chunk_rates)
     mfu = tok * flops_per_token(cfg, seq) / peak_flops
     _emit({
@@ -541,6 +590,7 @@ def main_xl_compute():
             "loss": loss,
             "params": cfg.num_params(),
             "chunk_rates": chunk_rates,
+            "chunk_log": chunk_log,
             "note": "fwd+bwd only (no optimizer state on device): the "
                     "1.5B compute anchor; --xl carries the capacity/"
                     "offload story",
@@ -604,9 +654,10 @@ def _measure_gpt2(batch, seq, steps):
     loss = engine.train_batch(batch=(batches[0], batches[0]))
     float(loss)
 
-    chunk_rates, loss = _timed_chunks(
+    chunk_log, loss = _timed_chunks(
         lambda ids: engine.train_batch(batch=(ids, ids)), batches[1:],
         chunk=5, tokens_per_step=batch * seq, label="headline")
+    chunk_rates = [c["rate"] for c in chunk_log]
     tokens_per_sec_per_chip = max(chunk_rates)
     mfu = tokens_per_sec_per_chip * flops_per_token(cfg, seq) / peak_flops
 
@@ -626,6 +677,7 @@ def _measure_gpt2(batch, seq, steps):
             "loss": loss,
             "params": cfg.num_params(),
             "chunk_rates": chunk_rates,
+            "chunk_log": chunk_log,
         },
     }
 
@@ -700,9 +752,10 @@ def _measure_bert(sparse, steps):
     loss = engine.train_batch(batch=batches[0])
     float(loss)  # compile barrier
 
-    chunk_rates, loss = _timed_chunks(
+    chunk_log, loss = _timed_chunks(
         lambda b: engine.train_batch(batch=b), batches[1:],
         chunk=4, tokens_per_step=batch * seq, label="bert")
+    chunk_rates = [c["rate"] for c in chunk_log]
     tok = max(chunk_rates)
 
     n_params = int(sum(int(np.prod(l.shape)) for l in
@@ -728,6 +781,7 @@ def _measure_bert(sparse, steps):
             "loss": loss,
             "attention_density": round(density, 4),
             "chunk_rates": chunk_rates,
+            "chunk_log": chunk_log,
         },
     })
 
@@ -987,6 +1041,133 @@ def main_serve(smoke=False, flash_decode=None, chunked_prefill=True,
     return 0
 
 
+def _measure_sustained(smoke=False):
+    """`bench.py --sustained`: the sustained-load harness end to end.
+
+    Where --serve answers "how fast is one short stream", this answers
+    the serving questions that only show up over TIME and LOAD: the
+    windowed TTFT/ITL p50/p99, queue-depth and slot-occupancy CURVES
+    (deepspeed_tpu/loadgen/ + telemetry.TimeseriesCollector), the SLO/
+    goodput verdict, a stepped-arrival-rate saturation sweep reporting
+    the max sustainable rate, and an A/A self-check of the noise-aware
+    regression gate. ``smoke`` sizes everything for a CPU/CI second or
+    two — same code path, same report schema, toy numbers; its SLO
+    budgets are deliberately generous (schema-exercise values, not
+    service targets) so a loaded CI box still produces a non-null
+    max_sustainable_rate. See docs/BENCHMARKING.md for how to use two
+    of these reports in an honest A/B."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.loadgen import (
+        SLO,
+        SustainedRunner,
+        WorkloadSpec,
+        build_report,
+        regression_gate,
+        saturation_sweep,
+    )
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu" and not smoke
+    if on_tpu:
+        cfg = GPT2Config.gpt2_medium(dropout=0.0, use_flash_attention=True)
+        serve_cfg = {"max_slots": 16, "max_len": 1024, "chunk_size": 16,
+                     "max_queue": 128}
+        base = dict(arrival="poisson", rate=12.0, n_requests=96,
+                    prompt_dist="lognormal", prompt_mean=64,
+                    prompt_max=256, output_dist="lognormal",
+                    output_mean=96, output_min=8, output_max=256,
+                    vocab_size=cfg.vocab_size, seed=17)
+        window_s, slo = 2.0, SLO(ttft_p99_ms=1500.0, itl_p99_ms=150.0)
+        sweep_rates, sweep_n = (8.0, 12.0, 16.0, 24.0), 48
+    else:
+        cfg = GPT2Config.tiny(dropout=0.0, use_flash_attention=False)
+        serve_cfg = {"max_slots": 4, "max_len": 64, "chunk_size": 4,
+                     "max_queue": 64}
+        # Dense enough that every window carries completions (the
+        # acceptance bar: >= 3 windows with real percentiles), short
+        # enough for tier-1.
+        base = dict(arrival="poisson", rate=60.0, n_requests=48,
+                    prompt_dist="lognormal", prompt_mean=8, prompt_max=16,
+                    output_dist="lognormal", output_mean=6, output_min=2,
+                    output_max=12, vocab_size=cfg.vocab_size, seed=17)
+        window_s = 0.1
+        # Schema-exercise budgets: wide enough that CPU jitter never
+        # nulls the sweep, tight enough that a wedged engine still fails.
+        slo = SLO(ttft_p99_ms=10000.0, itl_p99_ms=2000.0)
+        sweep_rates, sweep_n = (30.0, 60.0, 120.0), 16
+
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(0, cfg.vocab_size, size=(2, 16))
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(init_ids))["params"]
+    engine = deepspeed.init_inference(
+        model=model, params=params, config={"inference": serve_cfg})
+
+    # Warmup: compile the mixed-step program, freeze the compile total,
+    # open a fresh metrics window. From collector.start() on, the
+    # registry's window state belongs to the collector (timeseries.py) —
+    # no engine.metrics(reset=True) until the run's report is built.
+    engine.generate([np.arange(1, 9, dtype=np.int32)], max_new_tokens=2)
+    engine.recompile_detector.mark_warm()
+    engine.metrics(reset=True)
+
+    def run_spec(spec):
+        runner = SustainedRunner(engine, spec, window_seconds=window_s,
+                                 max_steps=500_000)
+        result = runner.run()
+        return build_report(
+            spec, result, slo, platform=platform,
+            extra={"git_hash": _git_state(),
+                   "model": "gpt2_medium" if on_tpu else "gpt2_tiny",
+                   "serve_cfg": dict(serve_cfg)})
+
+    report = run_spec(WorkloadSpec(**base))
+
+    # Saturation sweep: step the offered rate on the SAME warm engine
+    # (capacity, not compile time), shorter streams per step.
+    def sweep_step(rate):
+        return run_spec(WorkloadSpec(**dict(
+            base, rate=rate, n_requests=sweep_n, seed=int(rate) + 1000)))
+
+    report["saturation"] = saturation_sweep(
+        sweep_step, sweep_rates,
+        attainment_floor=0.95 if on_tpu else 0.5)
+    # A/A self-check: the gate against the report itself must pass (delta
+    # is exactly 0 everywhere) — stamped so every report proves its own
+    # gate is not trivially red.
+    report["gate_self_check"] = regression_gate(report, report)
+
+    agg = report["aggregate"]
+    return {
+        "metric": "gpt2_{}_sustained_goodput_tokens_per_sec_per_chip"
+                  .format("355m" if on_tpu else "tiny_smoke"),
+        "value": round(agg["goodput_tokens_per_sec_per_chip"], 1),
+        "unit": "tokens/s/chip",
+        # No sequential baseline here — goodput is an absolute serving
+        # number; A/B happens between two reports via the gate.
+        "vs_baseline": None,
+        "extra": {
+            "platform": platform,
+            "note": "windowed SLO report under 'sustained'; compare two "
+                    "runs with loadgen.regression_gate (see "
+                    "docs/BENCHMARKING.md)",
+            "sustained": report,
+        },
+    }
+
+
+def main_sustained(smoke=False):
+    if not smoke:
+        _require_tpu_or_exit()
+    _emit(_measure_sustained(smoke=smoke))
+    return 0
+
+
 def main_bert(sparse=False):
     _require_tpu_or_exit()
     _measure_bert(sparse=sparse, steps=12)
@@ -1031,6 +1212,8 @@ def _dispatch(argv):
     flash_decode = False if "--no-flash-decode" in argv else None
     chunked = "--no-chunked-prefill" not in argv
     spec = "--no-spec-decode" not in argv
+    if "--sustained" in argv:
+        return main_sustained(smoke="--smoke" in argv)
     if "--serve-smoke" in argv:
         return main_serve(smoke=True, flash_decode=flash_decode,
                           chunked_prefill=chunked, spec_decode=spec)

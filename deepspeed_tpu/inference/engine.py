@@ -504,6 +504,9 @@ class InferenceEngine(object):
             lambda: len(self._scheduler.queue))
         self.telemetry.gauge("slots_running").set_fn(
             lambda: len(self._scheduler.running))
+        self.telemetry.gauge("slots_prefilling").set_fn(
+            lambda: sum(1 for r in self._scheduler.running.values()
+                        if r.phase == "prefilling"))
         self.telemetry.gauge("slot_occupancy").set_fn(
             self._scheduler.occupancy)
         self.telemetry.gauge("kv_pool_bytes").set_fn(
@@ -782,6 +785,13 @@ class InferenceEngine(object):
         self._observe_compiles()
         return done
 
+    @property
+    def idle(self):
+        """True when no request is queued or in a slot — the drive
+        loops (run(), the sustained-load runner) poll this instead of
+        reaching into the scheduler."""
+        return self._scheduler.idle
+
     def run(self, max_steps=None):
         """Drive step() until queue and slots drain; returns completed
         requests in completion order."""
@@ -858,8 +868,15 @@ class InferenceEngine(object):
             "tokens_per_sec": c.window("tokens_out") / wall,
             "slot_occupancy": (c.window("occupied_slot_steps") /
                                max(c.window("slot_steps"), 1)),
-            "queue_depth": len(self._scheduler.queue),
+            # Instantaneous state comes from the live telemetry gauges —
+            # one source of truth with the Prometheus export and the
+            # sustained-load time-series, not a parallel scheduler peek.
+            "slot_occupancy_now": self.telemetry.gauge(
+                "slot_occupancy").value,
+            "queue_depth": int(self.telemetry.gauge("queue_depth").value),
             "running": len(self._scheduler.running),
+            "slots_prefilling": int(self.telemetry.gauge(
+                "slots_prefilling").value),
             "compile_count": self.compile_count,
             "recompiles": int(self.recompile_detector.recompiles.value),
             "prefill_seconds": self.timers(

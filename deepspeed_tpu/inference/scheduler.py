@@ -113,9 +113,13 @@ class Scheduler(object):
     def admissions(self):
         """FIFO: pop (request, slot) pairs for every free slot while the
         queue lasts, moving each request into the ``prefilling`` phase
-        (admit_time stamped — queue-wait ends here). Called by the
-        engine ONLY at step boundaries — the device programs never see a
-        mid-step batch change."""
+        (admit_time stamped — queue-wait ends here). BOTH engine paths
+        (legacy whole-prompt prefill and the chunked mixed step) admit
+        through this one method, so queue_wait_seconds is stamped at the
+        same point whichever program runs — the windowed queue-wait
+        curve is comparable across configs. Called by the engine ONLY at
+        step boundaries — the device programs never see a mid-step batch
+        change."""
         pairs = []
         for slot in self.free_slot_ids():
             if not self.queue:

@@ -1,0 +1,264 @@
+"""Sustained-load report: windowed SLO time-series, saturation sweep,
+noise-aware regression gate.
+
+The report is the harness's one artifact — a plain-JSON document that
+carries (a) the per-window curves (TTFT/ITL p50/p99, queue depth, slot
+occupancy, tokens/sec) the collector recorded, (b) run aggregates and
+the SLO/goodput verdict, and (c) enough provenance (workload echo,
+platform, schema version) that two reports can be compared honestly.
+
+The regression gate is NOISE-AWARE because a fixed threshold is wrong
+at both ends: tight enough to catch real 10% regressions, it flags
+run-to-run noise every week; loose enough to never false-alarm, it
+waves through real slowdowns. The windowed time-series is what breaks
+the dilemma — each report carries N per-window measurements of every
+metric, so the gate can estimate each run's OWN noise (standard error
+of the window mean) and demand the A/B delta clear both a relative
+floor and k standard errors of the combined noise. An A/A comparison
+(same report twice) has delta exactly 0 and always passes; a real 2x
+TTFT regression clears any plausible noise floor and fails — both ends
+are pinned by tests/unit/test_loadgen.py.
+"""
+
+import math
+
+from deepspeed_tpu.loadgen import slo as slo_mod
+
+SCHEMA_VERSION = 1
+
+# Gate polarity: which direction is a REGRESSION for each report
+# metric. Lower-is-better latencies only fail when they grow;
+# higher-is-better rates only fail when they shrink — an improvement
+# must never fail a gate (that trains people to stop running it).
+LOWER_IS_BETTER = ("ttft_p50_ms", "ttft_p99_ms", "itl_p50_ms",
+                   "itl_p99_ms", "queue_wait_p99_ms")
+HIGHER_IS_BETTER = ("tokens_per_sec", "goodput_tokens_per_sec",
+                    "goodput_tokens_per_sec_per_chip", "slo_attainment")
+GATE_DEFAULT_METRICS = ("ttft_p99_ms", "itl_p99_ms", "tokens_per_sec",
+                        "goodput_tokens_per_sec")
+
+# window-metric key -> (registry snapshot key, histogram stat, scale)
+_WINDOW_HIST = {
+    "ttft_p50_ms": ("ttft_seconds", "p50", 1e3),
+    "ttft_p99_ms": ("ttft_seconds", "p99", 1e3),
+    "itl_p50_ms": ("inter_token_seconds", "p50", 1e3),
+    "itl_p99_ms": ("inter_token_seconds", "p99", 1e3),
+    "queue_wait_p50_ms": ("queue_wait_seconds", "p50", 1e3),
+    "queue_wait_p99_ms": ("queue_wait_seconds", "p99", 1e3),
+}
+_WINDOW_GAUGE = {
+    "queue_depth": "queue_depth",
+    "slot_occupancy": "slot_occupancy",
+}
+
+
+def _window_rows(windows, t0):
+    """Flatten collector records into the report's window rows: stable
+    top-level keys (the schema the gate and the docs promise), times
+    relative to the run start."""
+    rows = []
+    for w in windows:
+        m = w["metrics"]
+        row = {
+            "index": w["index"],
+            "t_start_s": round(w["t_start"] - t0, 6),
+            "duration_s": round(w["duration_s"], 6),
+        }
+        for key, (src, stat, scale) in _WINDOW_HIST.items():
+            stats = m.get(src)
+            v = stats.get(stat) if isinstance(stats, dict) else None
+            row[key] = None if v is None else v * scale
+        for key, src in _WINDOW_GAUGE.items():
+            row[key] = m.get(src)
+        toks = m.get("tokens_out", 0) or 0
+        row["tokens_out"] = int(toks)
+        row["tokens_per_sec"] = toks / w["duration_s"]
+        row["requests_completed"] = int(m.get("requests_completed", 0) or 0)
+        rows.append(row)
+    return rows
+
+
+def _percentile(vals, p):
+    if not vals:
+        return None
+    s = sorted(vals)
+    return s[min(int(len(s) * p / 100.0), len(s) - 1)]
+
+
+def build_report(spec, result, slo, chips=1, platform=None, extra=None):
+    """Fold one RunResult into the report document.
+
+    Aggregates come from the per-request samples (exact, not windowed);
+    the ``windows`` rows carry the curves. ``extra`` merges caller
+    provenance (git hash, config digest, probe state) into
+    ``context`` — the gate reads context to warn when two reports were
+    never comparable to begin with."""
+    t0 = result.windows[0]["t_start"] if result.windows else 0.0
+    ttfts = [s["ttft_s"] * 1e3 for s in result.samples
+             if s["ttft_s"] is not None]
+    itls = [s["itl_s"] * 1e3 for s in result.samples
+            if s["itl_s"] is not None]
+    wall = max(result.wall_s, 1e-9)
+    slo_section = slo_mod.evaluate(result.samples, slo, result.wall_s,
+                                   chips=chips)
+    context = {"platform": platform, "chips": int(chips),
+               "seed": getattr(spec, "seed", None)}
+    if extra:
+        context.update(extra)
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "workload": spec.to_json() if hasattr(spec, "to_json") else None,
+        "context": context,
+        "aggregate": {
+            "wall_s": result.wall_s,
+            "submitted": result.submitted,
+            "completed": result.completed,
+            "shed": result.shed,
+            "tokens_out": result.tokens_out,
+            "tokens_per_sec": result.tokens_out / wall,
+            "ttft_p50_ms": _percentile(ttfts, 50),
+            "ttft_p99_ms": _percentile(ttfts, 99),
+            "itl_p50_ms": _percentile(itls, 50),
+            "itl_p99_ms": _percentile(itls, 99),
+            "slo_attainment": slo_section["attainment"],
+            "goodput_tokens_per_sec":
+                slo_section["goodput_tokens_per_sec"],
+            "goodput_tokens_per_sec_per_chip":
+                slo_section["goodput_tokens_per_sec_per_chip"],
+        },
+        "slo": slo_section,
+        "timeseries": {
+            "window_seconds": result.collector.window_seconds,
+            "windows_total": result.collector._idx,
+            "dropped": result.collector.dropped,
+            "windows": _window_rows(result.windows, t0),
+        },
+        "samples": result.samples,
+    }
+
+
+# ------------------------------------------------------------- saturation
+
+
+def saturation_sweep(run_fn, rates, attainment_floor=0.95):
+    """Step the offered arrival rate through ``rates`` and report the
+    max sustainable one.
+
+    ``run_fn(rate)`` runs one sustained pass at that offered rate and
+    returns its report (callers reuse ONE warm engine across steps —
+    the sweep measures capacity, not compile time). A rate is
+    SUSTAINABLE when SLO attainment held ``attainment_floor``; the knee
+    where attainment collapses and tokens/sec flatlines is the
+    engine's real capacity — the number a single-rate run can't give
+    you."""
+    steps = []
+    max_rate = None
+    for rate in rates:
+        rep = run_fn(rate)
+        att = rep["aggregate"]["slo_attainment"]
+        ok = att is not None and att >= attainment_floor
+        steps.append({
+            "rate": rate,
+            "attainment": att,
+            "tokens_per_sec": rep["aggregate"]["tokens_per_sec"],
+            "goodput_tokens_per_sec":
+                rep["aggregate"]["goodput_tokens_per_sec"],
+            "shed": rep["aggregate"]["shed"],
+            "sustainable": ok,
+        })
+        if ok and (max_rate is None or rate > max_rate):
+            max_rate = rate
+    return {"attainment_floor": attainment_floor, "rates": steps,
+            "max_sustainable_rate": max_rate}
+
+
+# ------------------------------------------------------------------- gate
+
+
+def _series(report, metric):
+    """Per-window series for ``metric``: the windowed samples the noise
+    floor is estimated from. Rate/goodput metrics don't have window
+    rows under those exact names — tokens_per_sec does, and the
+    goodput/attainment family falls back to it as its noise proxy (same
+    underlying token stream)."""
+    key = metric if metric in ("ttft_p50_ms", "ttft_p99_ms", "itl_p50_ms",
+                               "itl_p99_ms", "queue_wait_p50_ms",
+                               "queue_wait_p99_ms", "queue_depth",
+                               "slot_occupancy", "tokens_per_sec") \
+        else "tokens_per_sec"
+    vals = [w.get(key) for w in report["timeseries"]["windows"]]
+    return [v for v in vals if v is not None]
+
+
+def _rel_sem(series, center):
+    """Relative standard error of the window mean — this run's own
+    noise, in the same units as a relative delta. Fewer than 2 windows
+    (or a zero center) estimates nothing: returns 0, leaving the fixed
+    ``rel_tol`` floor in charge."""
+    n = len(series)
+    if n < 2 or not center:
+        return 0.0
+    mean = sum(series) / n
+    var = sum((v - mean) ** 2 for v in series) / (n - 1)
+    return math.sqrt(var / n) / abs(center)
+
+
+def _agg(report, metric):
+    if metric == "slo_attainment":
+        return report["aggregate"]["slo_attainment"]
+    return report["aggregate"].get(metric)
+
+
+def regression_gate(baseline, candidate, metrics=None, rel_tol=0.10,
+                    noise_k=3.0):
+    """Noise-aware A/B gate between two reports.
+
+    Per metric: relative delta of the aggregate values, compared
+    against ``threshold = max(rel_tol, noise_k * sqrt(sem_a^2 +
+    sem_b^2))`` where each sem is that run's relative standard error
+    estimated from its per-window series. A metric FLAGS only when the
+    delta exceeds the threshold IN THE REGRESSION DIRECTION for its
+    polarity — improvements never flag. Identical reports (A/A) have
+    delta 0 and pass by construction.
+
+    ``caveats`` lists context mismatches (platform, seed, schema) that
+    make the comparison itself suspect — the gate still runs, but a
+    red result on mismatched context blames the context first."""
+    metrics = list(metrics or GATE_DEFAULT_METRICS)
+    caveats = []
+    for k in ("platform", "seed"):
+        a = baseline.get("context", {}).get(k)
+        b = candidate.get("context", {}).get(k)
+        if a != b:
+            caveats.append("context.{} differs: {!r} vs {!r}".format(
+                k, a, b))
+    if baseline.get("schema_version") != candidate.get("schema_version"):
+        caveats.append("schema_version differs: {!r} vs {!r}".format(
+            baseline.get("schema_version"),
+            candidate.get("schema_version")))
+    rows = {}
+    for m in metrics:
+        a, b = _agg(baseline, m), _agg(candidate, m)
+        row = {"baseline": a, "candidate": b, "delta_rel": None,
+               "noise_floor": None, "threshold": None,
+               "direction": ("lower_is_better"
+                             if m in LOWER_IS_BETTER else
+                             "higher_is_better"),
+               "flagged": False}
+        if a is not None and b is not None and a != 0:
+            delta = (b - a) / abs(a)
+            noise = noise_k * math.sqrt(
+                _rel_sem(_series(baseline, m), a) ** 2 +
+                _rel_sem(_series(candidate, m), b) ** 2)
+            thr = max(rel_tol, noise)
+            regress = delta > thr if m in LOWER_IS_BETTER else delta < -thr
+            row.update({"delta_rel": delta, "noise_floor": noise,
+                        "threshold": thr, "flagged": bool(regress)})
+        rows[m] = row
+    return {
+        "pass": not any(r["flagged"] for r in rows.values()),
+        "rel_tol": rel_tol,
+        "noise_k": noise_k,
+        "metrics": rows,
+        "caveats": caveats,
+    }

@@ -1,0 +1,150 @@
+"""Open-loop sustained-load driver.
+
+OPEN loop means arrivals follow the workload's schedule, not the
+engine's pace: ``run()`` calls ``engine.submit()`` the moment each
+request's ``arrival_s`` passes, whatever the backlog looks like, and
+harvests completions separately. A closed-loop driver (next request
+only after the previous answer) self-throttles into exactly the load
+the engine can absorb — it can NEVER observe queueing collapse, which
+is the one thing a sustained-load harness exists to observe. Under open
+loop, saturation shows up honestly: queue depth climbs window over
+window, TTFT p99 grows without bound, and past ``max_queue`` the engine
+sheds (scheduler.QueueFull) — the runner records each shed as a sample
+row rather than dying, because shed traffic IS the signal.
+
+One ``TimeseriesCollector.tick()`` per loop iteration turns the run
+into per-window curves; one sample record per request (submitted or
+shed) carries the per-request view. ``loadgen/report.py`` folds both
+into the SLO report.
+"""
+
+import dataclasses
+import time
+
+from deepspeed_tpu.inference.scheduler import QueueFull
+from deepspeed_tpu.telemetry import TimeseriesCollector
+
+
+@dataclasses.dataclass
+class RunResult:
+    """Everything one sustained run produced: per-request ``samples``
+    (dict rows, shed included), the collector's per-window records, and
+    the run-level tallies report.py aggregates."""
+
+    samples: list
+    windows: list
+    collector: object
+    wall_s: float
+    submitted: int
+    completed: int
+    shed: int
+    tokens_out: int
+
+
+def _sample_row(lr, req):
+    """One per-request record from the scheduler Request's timestamp
+    trail (submit/first-token/finish are stamped by the engine at
+    harvest time — the runner only reads them back)."""
+    row = {
+        "arrival_s": lr.arrival_s,
+        "prompt_tokens": int(lr.prompt.size),
+        "max_new_tokens": int(lr.max_new_tokens),
+        "shed": req is None,
+        "rid": None if req is None else req.rid,
+        "ttft_s": None,
+        "e2e_s": None,
+        "itl_s": None,
+        "tokens_out": 0,
+        "completed": False,
+    }
+    if req is None:
+        return row
+    row["tokens_out"] = len(req.tokens)
+    if req.first_token_time is not None:
+        row["ttft_s"] = req.first_token_time - req.submit_time
+    if req.finish_time is not None:
+        row["completed"] = True
+        row["e2e_s"] = req.finish_time - req.submit_time
+        if req.first_token_time is not None and len(req.tokens) > 1:
+            row["itl_s"] = ((req.finish_time - req.first_token_time) /
+                            (len(req.tokens) - 1))
+    return row
+
+
+class SustainedRunner(object):
+    """Drive ``engine`` with ``spec``'s request stream, open loop.
+
+    The caller owns warmup: compile + ``recompile_detector.mark_warm()``
+    + ``engine.metrics(reset=True)`` BEFORE ``run()``, so neither
+    compile time nor warmup traffic pollutes the first window (the
+    collector owns the registry's window state from ``start()`` on —
+    see telemetry/timeseries.py).
+
+    ``clock``/``sleep`` are injectable for tests; ``max_steps`` is a
+    hard iteration backstop so a wedged engine fails the harness loudly
+    instead of hanging CI.
+    """
+
+    def __init__(self, engine, spec, window_seconds=1.0, max_windows=512,
+                 collector=None, max_steps=None, clock=time.time,
+                 sleep=time.sleep):
+        self.engine = engine
+        self.spec = spec
+        self._clock = clock
+        self._sleep = sleep
+        self.max_steps = max_steps
+        self.collector = collector or TimeseriesCollector(
+            engine.telemetry, window_seconds=window_seconds,
+            capacity=max_windows, clock=clock)
+
+    def run(self):
+        pending = self.spec.requests() if hasattr(self.spec, "requests") \
+            else list(self.spec)
+        handles = []          # (LoadRequest, Request-or-None) in order
+        t0 = self._clock()
+        self.collector.start(t0)
+        i, steps, shed = 0, 0, 0
+        while i < len(pending) or not self.engine.idle:
+            now = self._clock() - t0
+            # Submit everything whose arrival time has passed — open
+            # loop: the schedule, not the backlog, decides.
+            while i < len(pending) and pending[i].arrival_s <= now:
+                lr = pending[i]
+                try:
+                    handles.append((lr, self.engine.submit(
+                        lr.prompt, max_new_tokens=lr.max_new_tokens,
+                        temperature=lr.temperature, seed=lr.seed)))
+                except QueueFull:
+                    shed += 1
+                    handles.append((lr, None))
+                i += 1
+            if self.engine.idle:
+                # Nothing in flight: sleep to the next arrival, but
+                # never past the current window's close (the curve must
+                # keep its cadence through quiet gaps).
+                gap = pending[i].arrival_s - (self._clock() - t0)
+                if gap > 0:
+                    self._sleep(min(gap, self.collector.window_seconds))
+            else:
+                self.engine.step()
+                steps += 1
+                if self.max_steps is not None and steps > self.max_steps:
+                    raise RuntimeError(
+                        "sustained run exceeded max_steps={} with {} "
+                        "requests outstanding — engine wedged?".format(
+                            self.max_steps, len(pending) - i +
+                            sum(1 for _, r in handles
+                                if r is not None and not r.done)))
+            self.collector.tick()
+        self.collector.sample()   # flush the tail window
+        wall = self._clock() - t0
+        samples = [_sample_row(lr, req) for lr, req in handles]
+        return RunResult(
+            samples=samples,
+            windows=self.collector.windows(),
+            collector=self.collector,
+            wall_s=wall,
+            submitted=sum(1 for _, r in handles if r is not None),
+            completed=sum(1 for s in samples if s["completed"]),
+            shed=shed,
+            tokens_out=sum(s["tokens_out"] for s in samples))

@@ -1,0 +1,254 @@
+"""Seeded workload specs — deterministic open-loop request streams.
+
+A ``WorkloadSpec`` describes the TRAFFIC, not the engine: when requests
+arrive (Poisson / burst / ramp arrival processes, or a JSONL trace
+replayed verbatim), how long their prompts are and how many tokens they
+want back (heavy-tail lognormal / Zipf mixes — production length
+distributions are long-tailed, and a harness that offers uniform
+lengths never sees the head-of-line effects the tail causes), and what
+the prompt tokens actually are (repetition-heavy phrase tiling by
+default, so n-gram speculative drafting has self-matches to find — the
+same choice ``bench.py --serve`` makes).
+
+Everything is FULLY DETERMINISTIC per ``seed``: two calls to
+``spec.requests()`` — on different days, different machines — produce
+identical arrival times, identical token ids, identical budgets. That
+determinism is what makes an A/B comparable at all (two runs that
+served different streams measure the streams, not the code) and is
+pinned by tests/unit/test_loadgen.py.
+
+The spec is engine-agnostic and jax-free: ``requests()`` returns plain
+``LoadRequest`` rows the open-loop runner (runner.py) feeds to
+``engine.submit()`` at their scheduled times.
+"""
+
+import dataclasses
+import json
+import math
+from typing import Optional
+
+import numpy as np
+
+ARRIVALS = ("poisson", "burst", "ramp", "trace")
+LENGTH_DISTS = ("fixed", "lognormal", "zipf")
+
+
+@dataclasses.dataclass(eq=False)
+class LoadRequest:
+    """One scheduled request: WHEN it arrives and WHAT it asks for."""
+
+    arrival_s: float
+    prompt: np.ndarray          # int32 token ids
+    max_new_tokens: int
+    temperature: float = 0.0
+    seed: int = 0
+
+
+def _lengths(rng, dist, n, mean, sigma, zipf_a, lo, hi):
+    """``n`` integer lengths in [lo, hi] from the named distribution.
+
+    - ``lognormal``: mu chosen so the UNDERLYING mean is ``mean``
+      (heavier sigma = heavier right tail, same center).
+    - ``zipf``: ``lo * Zipf(a)`` — most draws sit at ``lo``, a power-law
+      tail reaches toward ``hi`` (the shared-prefix-plus-occasional-
+      novel-monster shape of real prompt traffic).
+    - ``fixed``: every length is ``mean``.
+    """
+    if lo < 1 or hi < lo:
+        raise ValueError("length bounds must satisfy 1 <= lo <= hi, got "
+                         "[{}, {}]".format(lo, hi))
+    if dist == "fixed":
+        lens = np.full(n, float(mean))
+    elif dist == "lognormal":
+        mu = math.log(max(float(mean), 1.0)) - sigma * sigma / 2.0
+        lens = rng.lognormal(mu, sigma, size=n)
+    elif dist == "zipf":
+        lens = float(lo) * rng.zipf(zipf_a, size=n)
+    else:
+        raise ValueError("unknown length distribution {!r}; one of "
+                         "{}".format(dist, LENGTH_DISTS))
+    return np.clip(np.rint(lens), lo, hi).astype(int)
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadSpec:
+    # Arrival process: 'poisson' (exponential gaps at ``rate``), 'burst'
+    # (groups of ``burst_size`` simultaneous arrivals every
+    # ``burst_gap_s``), 'ramp' (Poisson whose intensity ramps linearly
+    # ``ramp_from`` -> ``rate`` across the stream — the saturation-sweep
+    # shape in one run), 'trace' (replay ``trace_path`` JSONL verbatim).
+    arrival: str = "poisson"
+    # Mean arrivals/second (poisson), final rate (ramp); unused by
+    # 'burst' (its rate is burst_size / burst_gap_s) and 'trace'.
+    rate: float = 8.0
+    n_requests: int = 64
+    burst_size: int = 8
+    burst_gap_s: float = 1.0
+    ramp_from: float = 1.0
+    # Prompt-length mix (tokens).
+    prompt_dist: str = "lognormal"
+    prompt_mean: int = 64
+    prompt_sigma: float = 0.6
+    prompt_zipf_a: float = 2.2
+    prompt_min: int = 1
+    prompt_max: int = 256
+    # Output-budget mix (max_new_tokens per request).
+    output_dist: str = "lognormal"
+    output_mean: int = 64
+    output_sigma: float = 0.6
+    output_zipf_a: float = 2.2
+    output_min: int = 1
+    output_max: int = 128
+    vocab_size: int = 50257
+    # Prompt content: > 0 tiles a per-request random phrase of this many
+    # tokens to the prompt length (repetition-heavy — text repeats, and
+    # the n-gram drafter needs matches); 0 draws uniform random tokens.
+    phrase_len: int = 8
+    temperature: float = 0.0
+    # JSONL trace to replay when arrival == 'trace' (see replay_trace).
+    trace_path: Optional[str] = None
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.arrival not in ARRIVALS:
+            raise ValueError("unknown arrival process {!r}; one of "
+                             "{}".format(self.arrival, ARRIVALS))
+        if self.arrival == "trace":
+            if not self.trace_path:
+                raise ValueError(
+                    "arrival='trace' requires trace_path (a JSONL file — "
+                    "see loadgen.workload.save_trace)")
+        else:
+            if self.n_requests < 1:
+                raise ValueError("n_requests must be >= 1, got "
+                                 "{}".format(self.n_requests))
+            if self.rate <= 0:
+                raise ValueError("rate must be > 0, got "
+                                 "{}".format(self.rate))
+        if self.arrival == "burst" and (self.burst_size < 1 or
+                                        self.burst_gap_s <= 0):
+            raise ValueError("burst needs burst_size >= 1 and "
+                             "burst_gap_s > 0")
+        if self.arrival == "ramp" and self.ramp_from <= 0:
+            raise ValueError("ramp_from must be > 0, got "
+                             "{}".format(self.ramp_from))
+        for d in (self.prompt_dist, self.output_dist):
+            if d not in LENGTH_DISTS:
+                raise ValueError("unknown length distribution {!r}; one "
+                                 "of {}".format(d, LENGTH_DISTS))
+
+    # ---------------------------------------------------------- arrivals
+
+    def _arrival_times(self, rng):
+        n = self.n_requests
+        if self.arrival == "poisson":
+            return np.cumsum(rng.exponential(1.0 / self.rate, size=n))
+        if self.arrival == "burst":
+            group = np.arange(n) // self.burst_size
+            return group.astype(float) * self.burst_gap_s
+        # ramp: a Poisson process whose intensity ramps linearly from
+        # ramp_from to rate across the stream — gap i is an exponential
+        # draw at the instantaneous rate.
+        rates = np.linspace(self.ramp_from, self.rate, n)
+        return np.cumsum(rng.exponential(1.0, size=n) / rates)
+
+    # ---------------------------------------------------------- requests
+
+    def requests(self):
+        """The full request stream, arrival-sorted. Deterministic per
+        ``seed`` — every random draw comes from one RandomState(seed)
+        consumed in a fixed order."""
+        if self.arrival == "trace":
+            return replay_trace(self.trace_path,
+                                vocab_size=self.vocab_size, seed=self.seed)
+        rng = np.random.RandomState(self.seed)
+        arrivals = self._arrival_times(rng)
+        plens = _lengths(rng, self.prompt_dist, self.n_requests,
+                         self.prompt_mean, self.prompt_sigma,
+                         self.prompt_zipf_a, self.prompt_min,
+                         self.prompt_max)
+        outs = _lengths(rng, self.output_dist, self.n_requests,
+                        self.output_mean, self.output_sigma,
+                        self.output_zipf_a, self.output_min,
+                        self.output_max)
+        reqs = []
+        for i in range(self.n_requests):
+            n = int(plens[i])
+            if self.phrase_len > 0:
+                phrase = rng.randint(0, self.vocab_size,
+                                     size=(min(self.phrase_len, n),))
+                toks = np.tile(phrase, -(-n // phrase.size))[:n]
+            else:
+                toks = rng.randint(0, self.vocab_size, size=(n,))
+            reqs.append(LoadRequest(
+                arrival_s=float(arrivals[i]),
+                prompt=toks.astype(np.int32),
+                max_new_tokens=int(outs[i]),
+                temperature=self.temperature,
+                seed=int(rng.randint(0, 2 ** 31 - 1))))
+        return reqs
+
+    def to_json(self):
+        """JSON-safe echo of the spec for run reports (a report must
+        carry the workload that produced it — a gate comparing runs of
+        DIFFERENT workloads measures the workloads)."""
+        return dataclasses.asdict(self)
+
+
+# ------------------------------------------------------------------ trace
+
+
+def save_trace(requests, path):
+    """Write a request stream as replayable JSONL — one object per
+    request with explicit token ids, so replay is exact."""
+    with open(path, "w") as f:
+        for r in requests:
+            f.write(json.dumps({
+                "arrival_s": r.arrival_s,
+                "prompt": [int(t) for t in np.asarray(r.prompt)],
+                "max_new_tokens": int(r.max_new_tokens),
+                "temperature": float(r.temperature),
+                "seed": int(r.seed),
+            }))
+            f.write("\n")
+    return path
+
+
+def replay_trace(path, vocab_size=50257, seed=0):
+    """Load a JSONL trace into LoadRequest rows, arrival-sorted.
+
+    Each line needs ``arrival_s`` plus either ``prompt`` (explicit token
+    ids — exact replay) or ``prompt_len`` (tokens synthesized
+    deterministically from ``seed`` + line order, for traces captured
+    from systems that log lengths but not content). ``max_new_tokens``
+    defaults to 16; ``temperature``/``seed`` default to 0/line index.
+    """
+    rng = np.random.RandomState(seed)
+    reqs = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if "prompt" in row:
+                toks = np.asarray(row["prompt"], np.int32)
+            elif "prompt_len" in row:
+                toks = rng.randint(0, vocab_size,
+                                   size=(int(row["prompt_len"]),)
+                                   ).astype(np.int32)
+            else:
+                raise ValueError(
+                    "trace line {} has neither 'prompt' nor 'prompt_len'"
+                    .format(i + 1))
+            if toks.size < 1:
+                raise ValueError("trace line {} has an empty prompt"
+                                 .format(i + 1))
+            reqs.append(LoadRequest(
+                arrival_s=float(row["arrival_s"]),
+                prompt=toks,
+                max_new_tokens=int(row.get("max_new_tokens", 16)),
+                temperature=float(row.get("temperature", 0.0)),
+                seed=int(row.get("seed", i))))
+    reqs.sort(key=lambda r: r.arrival_s)
+    return reqs

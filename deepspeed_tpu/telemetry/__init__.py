@@ -6,6 +6,10 @@ One dependency-free subsystem every engine emits into:
   bounded-reservoir histograms with windowed snapshots.
 - ``SpanRecorder`` (tracing.py): per-request trace spans exported as
   Chrome trace-event JSON (Perfetto-loadable) and a JSONL flight ring.
+- ``TimeseriesCollector`` (timeseries.py): periodic windowed registry
+  snapshots in a bounded ring — the per-window TTFT/ITL/queue-depth
+  curves the sustained-load harness (loadgen/) reports, exportable as
+  Chrome counter events next to the span export.
 - ``RecompileDetector`` / ``annotate`` / ``profile_window``
   (instrumentation.py): jit cache-miss detection as a live gauge,
   ``jax.profiler.TraceAnnotation`` scoping, and the
@@ -37,9 +41,11 @@ from deepspeed_tpu.telemetry.registry import (
     MetricsRegistry,
     NullRegistry,
 )
+from deepspeed_tpu.telemetry.timeseries import TimeseriesCollector
 from deepspeed_tpu.telemetry.tracing import NullRecorder, SpanRecorder
 
 __all__ = [
+    "TimeseriesCollector",
     "Counter",
     "Gauge",
     "Histogram",

@@ -10,15 +10,22 @@ must always succeed on a bare interpreter.
 """
 
 import hashlib
+import math
 import threading
 
 from deepspeed_tpu.telemetry.registry import Histogram
 from deepspeed_tpu.utils.logging import logger
 
+# Label-value escapes per the Prometheus text exposition format: inside
+# a quoted label value exactly backslash, double-quote and line feed are
+# escaped (in that conceptual order — a single-pass translate makes the
+# order question moot, where chained str.replace calls would double- or
+# under-escape depending on sequencing).
+_LABEL_ESCAPES = {ord("\\"): "\\\\", ord('"'): '\\"', ord("\n"): "\\n"}
+
 
 def _escape_label(v):
-    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
-        "\n", "\\n")
+    return str(v).translate(_LABEL_ESCAPES)
 
 
 def _fmt_labels(labels, extra=None):
@@ -36,6 +43,12 @@ def _fmt_value(v):
     if v is None:
         return "NaN"
     f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        # The exposition format spells infinities '+Inf'/'-Inf';
+        # Python's repr ('inf') does not parse on the Prometheus side.
+        return "+Inf" if f > 0 else "-Inf"
     if f == int(f) and abs(f) < 1e15:
         return str(int(f))
     return repr(f)
@@ -93,7 +106,14 @@ class PrometheusEndpoint(object):
     """Opt-in stdlib scrape endpoint: GET /metrics serves
     ``prometheus_text(registry)``. Daemon thread; ``port=0`` picks a
     free port (read it back from ``.port``). Never started implicitly —
-    serving engines must not open sockets unasked."""
+    serving engines must not open sockets unasked.
+
+    Scrapes are CONCURRENT (ThreadingHTTPServer, one thread per
+    request) and must survive both each other and the serving loop
+    creating metrics mid-scrape: the registry's collect() walk is
+    structure-locked, and a handler that still fails (or whose client
+    hung up) answers 500 / drops the connection without taking the
+    endpoint — or the engine — down with it."""
 
     def __init__(self, registry, host="127.0.0.1", port=0):
         import http.server
@@ -105,18 +125,29 @@ class PrometheusEndpoint(object):
                 if self.path.rstrip("/") not in ("", "/metrics"):
                     self.send_error(404)
                     return
-                body = prometheus_text(reg).encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                try:
+                    body = prometheus_text(reg).encode()
+                except Exception as e:  # noqa: BLE001 — scrape must not
+                    # kill the endpoint; the error travels to the scraper.
+                    self.send_error(500, "scrape failed: {}".format(e))
+                    return
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # scraper hung up mid-response — its problem
 
             def log_message(self, *a):  # quiet: no per-scrape stderr spam
                 pass
 
         self._server = http.server.ThreadingHTTPServer((host, port), Handler)
+        # Scrape threads must never block interpreter exit (a wedged
+        # scraper holding a socket open would otherwise hang shutdown).
+        self._server.daemon_threads = True
         self.host, self.port = self._server.server_address[:2]
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="ds-tpu-metrics",

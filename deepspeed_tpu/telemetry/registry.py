@@ -22,8 +22,11 @@ Design constraints, in order:
   semantics); only the *window view* resets. Gauges are instantaneous
   and never windowed.
 - CHEAP on the hot path: a counter inc is one float add; a histogram
-  observe is O(1). No locks — the engines are single-threaded at step
-  boundaries; the optional HTTP exporter copies under the GIL.
+  observe is O(1), both lock-free (single mutations under the GIL). The
+  only lock guards the registry's STRUCTURE (metric creation and the
+  collect walk): a concurrent Prometheus scrape iterating the metric
+  table while the serving loop get-or-creates a new metric must never
+  hit "dictionary changed size during iteration".
 
 Metrics are identified by (name, sorted label items). ``MetricsRegistry``
 get-or-creates on access, so call sites just say
@@ -31,6 +34,7 @@ get-or-creates on access, so call sites just say
 """
 
 import random
+import threading
 
 
 def _label_key(labels):
@@ -183,24 +187,28 @@ class MetricsRegistry(object):
         self.namespace = namespace
         self.const_labels = dict(const_labels)
         # name -> {label_key: metric}; kind checked on re-access so one
-        # name never silently serves two metric types.
+        # name never silently serves two metric types. The lock guards
+        # this structure only — reads/writes of an already-created
+        # metric stay lock-free (call sites cache the metric object).
         self._metrics = {}
         self._kinds = {}
+        self._lock = threading.Lock()
 
     def _get(self, cls, name, labels, **kw):
-        kind = self._kinds.setdefault(name, cls)
-        if kind is not cls:
-            raise TypeError(
-                "metric {!r} already registered as {} (requested {})"
-                .format(name, kind.__name__, cls.__name__))
-        merged = dict(self.const_labels, **labels)
-        family = self._metrics.setdefault(name, {})
-        key = _label_key(merged)
-        metric = family.get(key)
-        if metric is None:
-            metric = cls(name, merged, **kw)
-            family[key] = metric
-        return metric
+        with self._lock:
+            kind = self._kinds.setdefault(name, cls)
+            if kind is not cls:
+                raise TypeError(
+                    "metric {!r} already registered as {} (requested {})"
+                    .format(name, kind.__name__, cls.__name__))
+            merged = dict(self.const_labels, **labels)
+            family = self._metrics.setdefault(name, {})
+            key = _label_key(merged)
+            metric = family.get(key)
+            if metric is None:
+                metric = cls(name, merged, **kw)
+                family[key] = metric
+            return metric
 
     def counter(self, name, **labels):
         return self._get(Counter, name, labels)
@@ -214,11 +222,18 @@ class MetricsRegistry(object):
 
     def collect(self):
         """Yield (name, kind, [metric...]) per family, names sorted —
-        the exporter walk order."""
-        for name in sorted(self._metrics):
-            family = self._metrics[name]
-            kind = self._kinds[name].__name__.lower()
-            yield name, kind, [family[k] for k in sorted(family)]
+        the exporter walk order. The family table is materialized under
+        the structure lock, so a scrape racing metric creation (the
+        threaded PrometheusEndpoint against the serving loop) sees a
+        consistent point-in-time metric SET — individual values may
+        still move underneath, which is normal scrape semantics."""
+        with self._lock:
+            families = [(name, self._kinds[name].__name__.lower(),
+                         [self._metrics[name][k]
+                          for k in sorted(self._metrics[name])])
+                        for name in sorted(self._metrics)]
+        for item in families:
+            yield item
 
     def snapshot(self, reset=False):
         """Plain-dict view: counters report their WINDOW value (since
@@ -245,9 +260,11 @@ class MetricsRegistry(object):
         return out
 
     def reset_window(self):
-        for family in self._metrics.values():
-            for m in family.values():
-                m.reset_window()
+        with self._lock:
+            metrics = [m for family in self._metrics.values()
+                       for m in family.values()]
+        for m in metrics:
+            m.reset_window()
 
 
 class _NullMetric(object):

@@ -183,6 +183,59 @@ def test_staggered_stream_parity_and_zero_recompiles():
     assert m["queue_depth"] == 0 and m["running"] == 0
 
 
+def test_metrics_reads_live_gauges_and_engine_idle():
+    """metrics() instantaneous keys come from the registry's live
+    gauges — one source of truth with the Prometheus export — and the
+    public engine.idle mirrors the scheduler (the sustained-load runner
+    polls it instead of reaching into _scheduler)."""
+    cfg, model, params = make_model()
+    eng = engine_of(model, params, max_slots=2, max_queue=8)
+    assert eng.idle
+    ps = prompts_of(cfg, [5, 6, 7, 8])
+    for p in ps:
+        # Budget long enough that nothing completes within the first
+        # mixed step (prefill emits 1 + one decode chunk).
+        eng.submit(p, max_new_tokens=12)
+    assert not eng.idle
+    m = eng.metrics()
+    # 4 submitted, 0 admitted yet: all queued, nothing prefilling.
+    assert m["queue_depth"] == 4
+    assert m["slot_occupancy_now"] == 0.0 and m["slots_prefilling"] == 0
+    eng.step()  # admits into both slots, first mixed step
+    m = eng.metrics()
+    assert m["queue_depth"] == 2 and m["slot_occupancy_now"] == 1.0
+    # One prefill lane per step: the second admitted request is still
+    # mid-prefill — visible on the live gauge.
+    assert m["slots_prefilling"] == 1
+    # The dict view and the Prometheus text can never disagree.
+    assert 'queue_depth{engine="inference"} 2' in eng.prometheus()
+    eng.run()
+    assert eng.idle
+    m = eng.metrics()
+    assert m["queue_depth"] == 0 and m["slot_occupancy_now"] == 0.0
+    assert m["slots_prefilling"] == 0
+
+
+@pytest.mark.parametrize("chunked", [True, False])
+def test_queue_wait_stamped_at_admission_on_both_paths(chunked):
+    """Both engine paths admit through Scheduler.admissions(), so
+    queue_wait_seconds is populated with one observation per request
+    whichever program runs — the windowed queue-wait curve is
+    comparable across configs."""
+    cfg, model, params = make_model()
+    kw = {} if chunked else {"chunked_prefill": False,
+                             "prefill_buckets": (16,)}
+    eng = engine_of(model, params, max_slots=2, **kw)
+    ps = prompts_of(cfg, [5, 6, 7, 8, 9], seed=6)
+    reqs = [eng.submit(p, max_new_tokens=2) for p in ps]
+    eng.run()
+    assert all(r.admit_time is not None and
+               r.admit_time >= r.submit_time for r in reqs)
+    hist = eng.telemetry.histogram("queue_wait_seconds")
+    assert hist.count == len(ps)
+    assert eng.metrics()["queue_wait_p99_ms"] is not None
+
+
 def test_second_bucket_compiles_once_then_stays():
     # LEGACY path: the bucket table only applies with chunked prefill off.
     cfg, model, params = make_model()

@@ -1,0 +1,444 @@
+"""Sustained-load harness (deepspeed_tpu/loadgen/ + telemetry/timeseries).
+
+The contract under test:
+1. DETERMINISM — a WorkloadSpec produces byte-identical request streams
+   per seed (arrivals, token ids, budgets); different seeds differ.
+   Without this, no two sustained runs are comparable.
+2. TIME-SERIES — the collector closes windows on cadence, holds bounded
+   memory (ring + exact dropped count), reports per-window counter
+   DELTAS, and exports schema-valid Chrome counter events.
+3. OPEN LOOP — the runner submits on the schedule, records QueueFull
+   sheds as samples (signal, not error), and drains to completion.
+4. GATE — the noise-aware regression gate passes an A/A (identical
+   reports) and FAILS an injected 2x TTFT slowdown and a throughput
+   drop, in the regression direction only (improvements never flag).
+5. END TO END — bench's --sustained --smoke path produces the promised
+   report schema: >= 3 windows carrying TTFT/ITL percentiles, queue
+   depth, slot occupancy; a non-null max sustainable rate; a passing
+   A/A self-check (the ISSUE acceptance criteria).
+"""
+
+import copy
+import json
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.loadgen import (
+    SLO,
+    SustainedRunner,
+    WorkloadSpec,
+    build_report,
+    evaluate,
+    regression_gate,
+    replay_trace,
+    saturation_sweep,
+    save_trace,
+)
+from deepspeed_tpu.telemetry import MetricsRegistry, TimeseriesCollector
+from tests.unit.test_chunked_prefill import engine_of, make_model
+
+# ---------------------------------------------------------------- workload
+
+
+def _spec(**kw):
+    kw.setdefault("vocab_size", 1024)
+    kw.setdefault("n_requests", 16)
+    kw.setdefault("prompt_mean", 8)
+    kw.setdefault("prompt_max", 16)
+    kw.setdefault("output_mean", 6)
+    kw.setdefault("output_max", 12)
+    return WorkloadSpec(**kw)
+
+
+def test_workload_deterministic_per_seed():
+    a = _spec(seed=7).requests()
+    b = _spec(seed=7).requests()
+    assert len(a) == 16
+    for x, y in zip(a, b):
+        assert x.arrival_s == y.arrival_s
+        assert np.array_equal(x.prompt, y.prompt)
+        assert x.max_new_tokens == y.max_new_tokens
+        assert x.seed == y.seed
+    c = _spec(seed=8).requests()
+    assert any(x.arrival_s != y.arrival_s for x, y in zip(a, c))
+    assert any(not np.array_equal(x.prompt, y.prompt)
+               for x, y in zip(a, c))
+
+
+def test_workload_shapes_and_bounds():
+    # Burst: groups of burst_size sharing one arrival instant.
+    bs = _spec(arrival="burst", n_requests=12, burst_size=4,
+               burst_gap_s=0.5).requests()
+    assert [r.arrival_s for r in bs[:5]] == [0.0, 0.0, 0.0, 0.0, 0.5]
+    # Ramp: early inter-arrival gaps are larger than late ones on
+    # average (intensity ramps ramp_from -> rate).
+    rp = _spec(arrival="ramp", rate=50.0, ramp_from=1.0,
+               n_requests=60).requests()
+    gaps = np.diff([r.arrival_s for r in rp])
+    assert gaps[:15].mean() > gaps[-15:].mean()
+    # Every stream respects the length bounds and the vocab.
+    for spec in (_spec(prompt_dist="zipf"), _spec(output_dist="fixed"),
+                 _spec(phrase_len=0)):
+        for r in spec.requests():
+            assert 1 <= r.prompt.size <= 16
+            assert 1 <= r.max_new_tokens <= 12
+            assert r.prompt.dtype == np.int32
+            assert int(r.prompt.max()) < 1024
+    # Phrase tiling repeats: a prompt longer than phrase_len contains
+    # its own prefix again (what the n-gram drafter matches on).
+    long = [r for r in _spec(phrase_len=4, prompt_dist="fixed",
+                             prompt_mean=12).requests()]
+    assert all(np.array_equal(r.prompt[:4], r.prompt[4:8]) for r in long)
+
+
+def test_workload_validation():
+    with pytest.raises(ValueError):
+        _spec(arrival="uniform")
+    with pytest.raises(ValueError):
+        _spec(rate=0.0)
+    with pytest.raises(ValueError):
+        _spec(arrival="trace")          # no trace_path
+    with pytest.raises(ValueError):
+        _spec(prompt_dist="cauchy")
+
+
+def test_trace_roundtrip_and_len_only_replay(tmp_path):
+    reqs = _spec(seed=3).requests()
+    path = str(tmp_path / "trace.jsonl")
+    save_trace(reqs, path)
+    back = replay_trace(path)
+    assert len(back) == len(reqs)
+    for x, y in zip(reqs, back):
+        assert x.arrival_s == y.arrival_s
+        assert np.array_equal(x.prompt, y.prompt)
+        assert x.max_new_tokens == y.max_new_tokens
+    # The spec's trace arrival mode replays the same file.
+    tr = WorkloadSpec(arrival="trace", trace_path=path,
+                      vocab_size=1024).requests()
+    assert np.array_equal(tr[0].prompt, reqs[0].prompt)
+    # Length-only lines synthesize tokens deterministically per seed.
+    p2 = str(tmp_path / "lens.jsonl")
+    with open(p2, "w") as f:
+        f.write(json.dumps({"arrival_s": 0.5, "prompt_len": 6}) + "\n")
+        f.write(json.dumps({"arrival_s": 0.1, "prompt_len": 3}) + "\n")
+    r1 = replay_trace(p2, vocab_size=64, seed=5)
+    r2 = replay_trace(p2, vocab_size=64, seed=5)
+    assert [r.arrival_s for r in r1] == [0.1, 0.5]  # arrival-sorted
+    assert all(np.array_equal(a.prompt, b.prompt) for a, b in zip(r1, r2))
+
+
+# ------------------------------------------------------------- timeseries
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_timeseries_windows_on_cadence_with_counter_deltas():
+    reg = MetricsRegistry()
+    tok = reg.counter("tokens_out")
+    clock = FakeClock()
+    col = TimeseriesCollector(reg, window_seconds=1.0, clock=clock)
+    col.start()
+    tok.inc(10)
+    clock.t += 0.5
+    assert col.tick() is None            # window not elapsed
+    clock.t += 0.6
+    w0 = col.tick()                      # 1.1s window closes
+    assert w0["metrics"]["tokens_out"] == 10   # the DELTA, not the total
+    tok.inc(7)
+    clock.t += 1.0
+    w1 = col.tick()
+    assert w1["metrics"]["tokens_out"] == 7    # next window's own delta
+    assert w1["index"] == 1
+    assert w1["t_start"] == w0["t_end"]        # contiguous windows
+    # A stall closes ONE long window, not a run of empties.
+    tok.inc(3)
+    clock.t += 5.0
+    w2 = col.tick()
+    assert w2["duration_s"] == pytest.approx(5.0)
+    assert col.tick() is None                  # no fabricated extras
+
+
+def test_timeseries_ring_bounded_with_exact_dropped_count():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    col = TimeseriesCollector(reg, window_seconds=1.0, capacity=4,
+                              clock=clock)
+    col.start()
+    for _ in range(10):
+        clock.t += 1.0
+        col.sample()
+    wins = col.windows()
+    assert len(wins) == 4                      # bounded
+    assert col.dropped == 6                    # exact eviction count
+    assert [w["index"] for w in wins] == [6, 7, 8, 9]  # newest win
+    j = col.to_json()
+    assert j["windows_total"] == 10 and j["dropped"] == 6
+    json.dumps(j)                              # export is JSON-safe
+
+
+def test_timeseries_chrome_counter_events():
+    reg = MetricsRegistry()
+    reg.gauge("queue_depth").set(3)
+    h = reg.histogram("ttft_seconds")
+    clock = FakeClock()
+    col = TimeseriesCollector(reg, window_seconds=1.0, clock=clock)
+    col.start()
+    h.observe(0.02)      # after start(): start() opens a fresh window
+    clock.t += 1.0
+    col.sample()
+    events = col.chrome_counter_events(pid=7)
+    names = {e["name"] for e in events}
+    assert "queue_depth" in names
+    assert "ttft_seconds_p50" in names and "ttft_seconds_p99" in names
+    for e in events:
+        assert e["ph"] == "C" and e["pid"] == 7
+        assert isinstance(e["args"]["value"], float)
+        assert e["ts"] == pytest.approx(1e6)   # µs since first window
+    with pytest.raises(RuntimeError):
+        TimeseriesCollector(reg).sample()      # sample before start
+
+
+# -------------------------------------------------------------------- slo
+
+
+def _row(ttft=0.01, itl=0.005, tokens=8, shed=False, completed=True):
+    return {"shed": shed, "completed": completed, "ttft_s": ttft,
+            "itl_s": itl, "tokens_out": tokens}
+
+
+def test_slo_evaluate_attainment_and_goodput():
+    slo = SLO(ttft_p99_ms=100.0, itl_p99_ms=50.0)
+    samples = [
+        _row(),                               # meets
+        _row(ttft=0.5),                       # TTFT bust
+        _row(itl=0.2),                        # ITL bust
+        _row(shed=True, completed=False, tokens=0),   # shed
+        _row(itl=None, tokens=1),             # 1-token: TTFT-only, meets
+    ]
+    out = evaluate(samples, slo, wall_s=2.0, chips=2)
+    assert out["requests"] == 5 and out["shed"] == 1
+    assert out["slo_met"] == 2
+    assert out["attainment"] == pytest.approx(0.4)
+    # goodput counts ONLY the meeting requests' tokens (8 + 1) / wall.
+    assert out["goodput_tokens_per_sec"] == pytest.approx(4.5)
+    assert out["goodput_tokens_per_sec_per_chip"] == pytest.approx(2.25)
+
+
+# ------------------------------------------------------------------- gate
+
+
+def _fake_report(ttft_ms=10.0, itl_ms=1.0, tps=500.0, jitter=0.0,
+                 platform="cpu", seed=17):
+    """A minimal schema-true report: N windows whose values wobble by
+    ``jitter`` (relative) around the aggregates, so the gate has a real
+    series to estimate noise from."""
+    wobble = [1.0 - jitter, 1.0 + jitter, 1.0, 1.0 - jitter / 2,
+              1.0 + jitter / 2, 1.0]
+    windows = [{
+        "index": i,
+        "ttft_p99_ms": ttft_ms * w, "ttft_p50_ms": ttft_ms * w / 2,
+        "itl_p99_ms": itl_ms * w, "itl_p50_ms": itl_ms * w / 2,
+        "queue_wait_p99_ms": 1.0, "queue_depth": 0.0,
+        "slot_occupancy": 0.5, "tokens_per_sec": tps * w,
+    } for i, w in enumerate(wobble)]
+    return {
+        "schema_version": 1,
+        "context": {"platform": platform, "seed": seed},
+        "aggregate": {
+            "ttft_p99_ms": ttft_ms, "ttft_p50_ms": ttft_ms / 2,
+            "itl_p99_ms": itl_ms, "itl_p50_ms": itl_ms / 2,
+            "tokens_per_sec": tps, "goodput_tokens_per_sec": tps * 0.9,
+            "goodput_tokens_per_sec_per_chip": tps * 0.9,
+            "slo_attainment": 1.0,
+        },
+        "timeseries": {"window_seconds": 1.0, "windows": windows},
+    }
+
+
+def test_gate_aa_identical_reports_pass():
+    rep = _fake_report(jitter=0.2)
+    out = regression_gate(rep, copy.deepcopy(rep))
+    assert out["pass"]
+    assert out["caveats"] == []
+    for row in out["metrics"].values():
+        assert row["delta_rel"] == 0.0
+        assert not row["flagged"]
+
+
+def test_gate_flags_injected_2x_ttft_slowdown():
+    base = _fake_report(ttft_ms=10.0, jitter=0.05)
+    cand = _fake_report(ttft_ms=20.0, jitter=0.05)
+    out = regression_gate(base, cand)
+    assert not out["pass"]
+    row = out["metrics"]["ttft_p99_ms"]
+    assert row["flagged"] and row["delta_rel"] == pytest.approx(1.0)
+    # The delta cleared the noise-aware threshold, not a lucky default.
+    assert row["delta_rel"] > row["threshold"]
+
+
+def test_gate_flags_throughput_drop_but_not_improvements():
+    base = _fake_report(tps=500.0, jitter=0.05)
+    out = regression_gate(base, _fake_report(tps=300.0, jitter=0.05))
+    assert not out["pass"]
+    assert out["metrics"]["tokens_per_sec"]["flagged"]
+    # Polarity: a 2x TTFT IMPROVEMENT and a throughput GAIN never flag.
+    better = _fake_report(ttft_ms=5.0, tps=900.0, jitter=0.05)
+    assert regression_gate(base, better)["pass"]
+
+
+def test_gate_noise_floor_absorbs_noisy_delta():
+    # 12% delta, but both runs wobble 40% window-to-window: the noise
+    # floor (3 * combined SEM) exceeds the delta — no flag. The same
+    # delta on quiet runs DOES flag at rel_tol=0.05.
+    noisy = regression_gate(_fake_report(ttft_ms=10.0, jitter=0.4),
+                            _fake_report(ttft_ms=11.2, jitter=0.4),
+                            rel_tol=0.05)
+    assert not noisy["metrics"]["ttft_p99_ms"]["flagged"]
+    quiet = regression_gate(_fake_report(ttft_ms=10.0, jitter=0.001),
+                            _fake_report(ttft_ms=11.2, jitter=0.001),
+                            rel_tol=0.05)
+    assert quiet["metrics"]["ttft_p99_ms"]["flagged"]
+
+
+def test_gate_caveats_on_context_mismatch():
+    out = regression_gate(_fake_report(platform="tpu", seed=1),
+                          _fake_report(platform="cpu", seed=2))
+    assert any("platform" in c for c in out["caveats"])
+    assert any("seed" in c for c in out["caveats"])
+
+
+# ------------------------------------------------------------- runner e2e
+
+
+def _warm(engine):
+    engine.generate([np.arange(1, 9, dtype=np.int32)], max_new_tokens=2)
+    engine.recompile_detector.mark_warm()
+    engine.metrics(reset=True)
+
+
+def test_runner_open_loop_end_to_end():
+    cfg, model, params = make_model()
+    engine = engine_of(model, params, max_slots=4, max_queue=64)
+    _warm(engine)
+    spec = _spec(rate=80.0, n_requests=24, vocab_size=cfg.vocab_size,
+                 seed=11)
+    runner = SustainedRunner(engine, spec, window_seconds=0.1,
+                             max_steps=100_000)
+    res = runner.run()
+    assert res.submitted == 24 and res.shed == 0
+    assert res.completed == 24
+    assert res.tokens_out > 0 and engine.idle
+    assert len(res.windows) >= 1
+    done = [s for s in res.samples if s["completed"]]
+    assert all(s["ttft_s"] is not None and s["ttft_s"] >= 0 for s in done)
+    assert all(s["e2e_s"] >= s["ttft_s"] for s in done)
+    # Report over the real run: schema keys + JSON-safe.
+    rep = build_report(spec, res, SLO(ttft_p99_ms=1e4, itl_p99_ms=2e3),
+                       platform="cpu")
+    assert rep["aggregate"]["completed"] == 24
+    assert rep["slo"]["attainment"] == 1.0
+    json.dumps(rep)
+
+
+def test_runner_records_queuefull_as_shed_samples():
+    cfg, model, params = make_model()
+    # max_queue=2 against a 24-request burst: the overflow MUST shed.
+    engine = engine_of(model, params, max_slots=2, max_queue=2)
+    _warm(engine)
+    spec = _spec(arrival="burst", n_requests=24, burst_size=24,
+                 vocab_size=cfg.vocab_size, seed=4)
+    res = SustainedRunner(engine, spec, window_seconds=0.1,
+                          max_steps=100_000).run()
+    assert res.shed > 0
+    assert res.submitted + res.shed == 24
+    shed_rows = [s for s in res.samples if s["shed"]]
+    assert len(shed_rows) == res.shed
+    assert all(s["tokens_out"] == 0 and not s["completed"]
+               for s in shed_rows)
+    # Sheds count against attainment: it can't be 1.0.
+    rep = build_report(spec, res, SLO(ttft_p99_ms=1e4, itl_p99_ms=2e3))
+    assert rep["slo"]["attainment"] < 1.0
+
+
+# ------------------------------------------------------------- saturation
+
+
+def test_saturation_sweep_reports_knee():
+    # run_fn fakes a server that holds SLO to rate 16 and collapses at
+    # 24 — the sweep must report 16, not 24 and not None.
+    def run_fn(rate):
+        ok = rate <= 16
+        rep = _fake_report(tps=rate * 30)
+        rep["aggregate"]["slo_attainment"] = 1.0 if ok else 0.4
+        rep["aggregate"]["shed"] = 0 if ok else 5
+        return rep
+
+    out = saturation_sweep(run_fn, (8, 16, 24), attainment_floor=0.95)
+    assert out["max_sustainable_rate"] == 16
+    flags = [(s["rate"], s["sustainable"]) for s in out["rates"]]
+    assert flags == [(8, True), (16, True), (24, False)]
+
+
+# ------------------------------------------------------- bench end to end
+
+
+def test_bench_sustained_smoke_report():
+    """The ISSUE acceptance criteria, asserted on bench's own smoke
+    path in-process: >= 3 windows each carrying TTFT/ITL percentiles,
+    queue depth and slot occupancy; a non-null max sustainable rate; a
+    passing A/A gate self-check."""
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "..", "bench.py")
+    spec = importlib.util.spec_from_file_location("ds_bench_sust", path)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    result = bench._measure_sustained(smoke=True)
+    json.dumps(result)                        # the emitted line is JSON
+    assert result["unit"] == "tokens/s/chip"
+    assert result["value"] > 0
+    rep = result["extra"]["sustained"]
+    assert rep["schema_version"] == 1
+    wins = rep["timeseries"]["windows"]
+    carrying = [w for w in wins
+                if w["ttft_p99_ms"] is not None
+                and w["itl_p99_ms"] is not None
+                and w["queue_depth"] is not None
+                and w["slot_occupancy"] is not None]
+    assert len(carrying) >= 3
+    assert all(w["ttft_p50_ms"] <= w["ttft_p99_ms"] for w in carrying)
+    assert rep["saturation"]["max_sustainable_rate"] is not None
+    assert rep["gate_self_check"]["pass"]
+    # The workload echo + context make the report self-describing.
+    assert rep["workload"]["seed"] == rep["context"]["seed"]
+    assert rep["aggregate"]["completed"] == rep["slo"]["requests"] - \
+        rep["slo"]["shed"]
+
+
+@pytest.mark.slow
+def test_sustained_ramp_soak_shows_saturation_curve():
+    """Fuller soak (slow tier): a ramp workload driven past the tiny
+    engine's capacity produces a queue-depth curve that actually rises,
+    and the saturation sweep's unsustainable step sheds."""
+    cfg, model, params = make_model()
+    engine = engine_of(model, params, max_slots=2, max_queue=8)
+    _warm(engine)
+    spec = _spec(arrival="ramp", ramp_from=2.0, rate=400.0,
+                 n_requests=96, output_mean=10, output_max=12,
+                 vocab_size=cfg.vocab_size, seed=9)
+    res = SustainedRunner(engine, spec, window_seconds=0.2,
+                          max_steps=1_000_000).run()
+    rep = build_report(spec, res, SLO(ttft_p99_ms=50.0, itl_p99_ms=50.0))
+    depths = [w["queue_depth"] for w in rep["timeseries"]["windows"]
+              if w["queue_depth"] is not None]
+    assert max(depths) > 0                   # backlog became visible
+    assert rep["slo"]["attainment"] < 1.0    # the ramp outran the engine

@@ -275,6 +275,77 @@ def test_prometheus_digest_fingerprints_shape():
     assert sha2 != sha and n2 == 1  # value changed, line count stable
 
 
+def test_prometheus_label_escaping_and_special_values():
+    """Label values with backslash / quote / newline must escape per the
+    exposition format (single-pass — no double-escaping the backslash),
+    and non-finite values must spell +Inf/-Inf/NaN, not Python's repr
+    ('inf' does not parse on the Prometheus side)."""
+    from deepspeed_tpu.telemetry.exporters import (_escape_label,
+                                                   _fmt_value)
+
+    assert _escape_label('a\\b"c\nd') == 'a\\\\b\\"c\\nd'
+    # Order-independence: an already-escaped-looking value escapes each
+    # character exactly once.
+    assert _escape_label("\\n") == "\\\\n"
+    reg = MetricsRegistry()
+    reg.gauge("g", path='C:\\tmp\n"x"').set(1)
+    text = prometheus_text(reg)
+    assert 'path="C:\\\\tmp\\n\\"x\\""' in text
+    assert _fmt_value(float("inf")) == "+Inf"
+    assert _fmt_value(float("-inf")) == "-Inf"
+    assert _fmt_value(float("nan")) == "NaN"
+    assert _fmt_value(None) == "NaN"
+    assert _fmt_value(3) == "3" and _fmt_value(2.5) == "2.5"
+    reg.gauge("inf_gauge").set(float("inf"))
+    assert "ds_tpu_inf_gauge +Inf" in prometheus_text(reg)
+
+
+def test_prometheus_endpoint_survives_concurrent_scrapes():
+    """Hammer the endpoint from several threads WHILE the registry grows
+    new metrics — the collect() walk is structure-locked, so no scrape
+    may 500 on 'dictionary changed size during iteration'."""
+    import threading
+
+    reg = MetricsRegistry()
+    reg.counter("base").inc(1)
+    ep = PrometheusEndpoint(reg, port=0)
+    url = "http://{}:{}/metrics".format(ep.host, ep.port)
+    errors = []
+    stop = threading.Event()
+
+    def scrape():
+        for _ in range(15):
+            try:
+                body = urllib.request.urlopen(url, timeout=30).read()
+                assert b"ds_tpu_base_total" in body
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                errors.append(e)
+
+    def churn():
+        # Bounded creation rate: the point is mutation DURING collect,
+        # not an unboundedly growing export (which would just make every
+        # scrape slower until it times out).
+        for i in range(400):
+            if stop.is_set():
+                return
+            reg.counter("churn_{}".format(i % 40)).inc(1)
+            reg.histogram("hist_{}".format(i % 40)).observe(0.1)
+
+    t_churn = threading.Thread(target=churn, daemon=True)
+    scrapers = [threading.Thread(target=scrape) for _ in range(4)]
+    try:
+        t_churn.start()
+        for t in scrapers:
+            t.start()
+        for t in scrapers:
+            t.join(timeout=30)
+    finally:
+        stop.set()
+        t_churn.join(timeout=5)
+        ep.close()
+    assert errors == []
+
+
 def test_prometheus_endpoint_serves_registry():
     reg = MetricsRegistry()
     reg.counter("scrapes").inc(4)
