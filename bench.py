@@ -1168,6 +1168,133 @@ def main_sustained(smoke=False):
     return 0
 
 
+def _measure_chaos(smoke=False):
+    """`bench.py --chaos-smoke`: the recovery invariant under load, as a
+    benchmark artifact.
+
+    One sustained run with a FaultPlan armed MID-RUN (loadgen chaos
+    mode): a fatal step fault fires against a live mixed batch, the
+    engine rebuilds its device state and replays every in-flight
+    request (docs/RESILIENCE.md). The run then ASSERTS the invariant —
+    the fault actually fired, at least one recovery happened, zero
+    accepted requests were lost — and stamps the recovery facts
+    (recovery_time_s, requests_lost, the SLO attainment split during/
+    outside recovery) into the JSON. ``smoke`` is the tiny-CPU tier-1
+    shape; on TPU the same path runs gpt2-medium."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.inference import Fault, FaultPlan
+    from deepspeed_tpu.loadgen import (
+        SLO,
+        SustainedRunner,
+        WorkloadSpec,
+        build_report,
+    )
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu" and not smoke
+    if on_tpu:
+        cfg = GPT2Config.gpt2_medium(dropout=0.0, use_flash_attention=True)
+        serve_cfg = {"max_slots": 16, "max_len": 1024, "chunk_size": 16,
+                     "max_queue": 128, "fault_injection": True}
+        spec = WorkloadSpec(arrival="poisson", rate=12.0, n_requests=64,
+                            prompt_dist="lognormal", prompt_mean=64,
+                            prompt_max=256, output_dist="lognormal",
+                            output_mean=96, output_min=8, output_max=256,
+                            vocab_size=cfg.vocab_size, seed=23)
+        window_s, slo = 2.0, SLO(ttft_p99_ms=1500.0, itl_p99_ms=150.0)
+    else:
+        cfg = GPT2Config.tiny(dropout=0.0, use_flash_attention=False)
+        serve_cfg = {"max_slots": 4, "max_len": 64, "chunk_size": 4,
+                     "max_queue": 64, "fault_injection": True}
+        # Long enough output streams that the fault lands mid-decode
+        # with several requests in flight — recovery with real replays.
+        spec = WorkloadSpec(arrival="poisson", rate=60.0, n_requests=32,
+                            prompt_dist="lognormal", prompt_mean=8,
+                            prompt_max=16, output_dist="lognormal",
+                            output_mean=8, output_min=4, output_max=12,
+                            vocab_size=cfg.vocab_size, seed=23)
+        window_s = 0.1
+        slo = SLO(ttft_p99_ms=10000.0, itl_p99_ms=2000.0)
+
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(0, cfg.vocab_size, size=(2, 16))
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(init_ids))["params"]
+    engine = deepspeed.init_inference(
+        model=model, params=params, config={"inference": serve_cfg})
+    engine.generate([np.arange(1, 9, dtype=np.int32)], max_new_tokens=2)
+    engine.recompile_detector.mark_warm()
+    engine.metrics(reset=True)
+
+    # ONE fatal step fault, two steps after arming (arming waits for the
+    # first window, so the batch is live when it fires).
+    plan = FaultPlan(faults=(Fault("raise", step=2),))
+    runner = SustainedRunner(engine, spec, window_seconds=window_s,
+                             max_steps=500_000, chaos_plan=plan,
+                             chaos_after_s=window_s / 2)
+    result = runner.run()
+    report = build_report(
+        spec, result, slo, platform=platform,
+        extra={"git_hash": _git_state(),
+               "model": "gpt2_medium" if on_tpu else "gpt2_tiny",
+               "serve_cfg": dict(serve_cfg),
+               "fault_plan": {"faults": [
+                   {"kind": f.kind, "step": f.step,
+                    "duration_steps": f.duration_steps}
+                   for f in plan.faults], "seed": plan.seed}})
+    chaos = report["chaos"]
+    post = engine.metrics()
+
+    # The invariant, asserted in the artifact's own build: the fault
+    # fired, recovery ran, nothing was lost, the engine came back
+    # healthy, and the rebuild reused the compiled program.
+    assert chaos["faults_injected"] >= 1, "fault never fired"
+    assert chaos["recoveries"] >= 1, "no recovery recorded"
+    assert chaos["requests_lost"] == 0, \
+        "recovery lost {} request(s)".format(chaos["requests_lost"])
+    assert math.isfinite(chaos["recovery_time_s"])
+    assert engine.health == "healthy" and engine.idle
+    assert post["compile_count"] == 1, \
+        "recovery recompiled: {}".format(post["compile_count"])
+
+    return {
+        "metric": "gpt2_{}_chaos_recovery_time_s".format(
+            "355m" if on_tpu else "tiny_smoke"),
+        "value": round(chaos["recovery_time_s"], 6),
+        "unit": "s",
+        "vs_baseline": None,
+        "extra": {
+            "platform": platform,
+            "requests_lost": chaos["requests_lost"],
+            "recoveries": chaos["recoveries"],
+            "faults_injected": chaos["faults_injected"],
+            "requests_replayed": sum(
+                r["replayed"] for r in chaos["recovery_intervals"]),
+            "slo_attainment_during_recovery":
+                chaos["slo_attainment_during_recovery"],
+            "slo_attainment_outside_recovery":
+                chaos["slo_attainment_outside_recovery"],
+            "note": "one injected fatal step fault mid-run; full windowed "
+                    "report under 'chaos_report' (docs/RESILIENCE.md)",
+            "chaos_report": report,
+        },
+    }
+
+
+def main_chaos(smoke=False):
+    if not smoke:
+        _require_tpu_or_exit()
+    _emit(_measure_chaos(smoke=smoke))
+    return 0
+
+
 def main_bert(sparse=False):
     _require_tpu_or_exit()
     _measure_bert(sparse=sparse, steps=12)
@@ -1212,6 +1339,10 @@ def _dispatch(argv):
     flash_decode = False if "--no-flash-decode" in argv else None
     chunked = "--no-chunked-prefill" not in argv
     spec = "--no-spec-decode" not in argv
+    if "--chaos-smoke" in argv:
+        return main_chaos(smoke=True)
+    if "--chaos" in argv:
+        return main_chaos(smoke="--smoke" in argv)
     if "--sustained" in argv:
         return main_sustained(smoke="--smoke" in argv)
     if "--serve-smoke" in argv:

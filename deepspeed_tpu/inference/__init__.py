@@ -10,7 +10,18 @@ or ``InferenceEngine`` directly.
 
 from deepspeed_tpu.inference.config import InferenceConfig  # noqa: F401
 from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: F401
+from deepspeed_tpu.inference.faults import (  # noqa: F401
+    Fault,
+    FaultPlan,
+    InjectedFault,
+)
 from deepspeed_tpu.inference.kv_pool import init_pool, kv_spec  # noqa: F401
+from deepspeed_tpu.inference.resilience import (  # noqa: F401
+    HEALTH_STATES,
+    EngineDeadError,
+    EngineDraining,
+    NumericsError,
+)
 from deepspeed_tpu.inference.scheduler import (  # noqa: F401
     QueueFull,
     Request,

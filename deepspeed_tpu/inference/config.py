@@ -35,6 +35,10 @@ INFERENCE_DEFAULTS = {
     "spec_ngram": 3,
     "telemetry": True,
     "trace_ring": 4096,
+    "fault_injection": False,
+    "step_budget_s": None,
+    "recovery_max_retries": 2,
+    "recovery_backoff_s": 0.0,
 }
 
 
@@ -124,6 +128,26 @@ class InferenceConfig:
     # trace_ring span/instant events are retained for export; exact
     # per-name span COUNTS survive wraparound.
     trace_ring: int = 4096
+    # Chaos switch: engine.inject_faults(FaultPlan) only arms when True
+    # (inference/faults.py). Off (the default), the injector is None and
+    # every hook is one ``is not None`` test — production configs cannot
+    # be chaos'd by accident. docs/RESILIENCE.md is the fault model.
+    fault_injection: bool = False
+    # Step watchdog wall-clock budget (seconds): a step still running
+    # past it trips the watchdog — warning log + ``step_stalls`` counter
+    # + degraded health — instead of the run going silently quiet. None
+    # (the default) disables the watchdog; detection only, a wedged
+    # device call cannot be preempted host-side (resilience.py).
+    step_budget_s: Optional[float] = None
+    # CONSECUTIVE failed-step recoveries tolerated before the engine
+    # transitions to dead (terminal; step()/submit() raise
+    # EngineDeadError). A clean step resets the streak — transient
+    # faults retry forever, a persistently failing device does not.
+    recovery_max_retries: int = 2
+    # Sleep before the Nth consecutive recovery attempt: backoff_s * N
+    # (linear). 0 disables — tests and single-fault chaos runs recover
+    # immediately.
+    recovery_backoff_s: float = 0.0
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -147,6 +171,16 @@ class InferenceConfig:
         if self.trace_ring < 1:
             raise ValueError("inference.trace_ring must be >= 1, got "
                              "{}".format(self.trace_ring))
+        if self.step_budget_s is not None and self.step_budget_s <= 0:
+            raise ValueError("inference.step_budget_s must be > 0 (or None "
+                             "to disable the watchdog), got "
+                             "{}".format(self.step_budget_s))
+        if self.recovery_max_retries < 0:
+            raise ValueError("inference.recovery_max_retries must be >= 0, "
+                             "got {}".format(self.recovery_max_retries))
+        if self.recovery_backoff_s < 0:
+            raise ValueError("inference.recovery_backoff_s must be >= 0, "
+                             "got {}".format(self.recovery_backoff_s))
         if self.spec_decode and not self.chunked_prefill:
             raise ValueError(
                 "inference.spec_decode=True requires chunked_prefill: "

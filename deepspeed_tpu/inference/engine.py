@@ -46,6 +46,23 @@ host sync, evict finished slots. Under greedy decoding the emitted
 tokens are token-identical to sequential ``generate`` calls — all paths
 drive the same decode step program (models/generation.py).
 
+CRASH-ONLY serving (docs/RESILIENCE.md): the host-side request records
+are the durable truth and the device pool is disposable. A fatal step
+error (XlaRuntimeError, an injected fault, or the harvest validity
+check catching device garbage) triggers RECOVERY — rebuild the pool
+through the same init path (same shapes, so the already-compiled
+programs serve it: compile_count unchanged), requeue every in-flight
+request, and REPLAY each as prompt + tokens-emitted-so-far with the
+remaining budget. The positional ``fold_in(seed, pos)`` rng makes the
+replayed stream bit-identical, greedy or sampled: token m+1 is drawn at
+absolute position P+m whether it is the m+1'th decode of the original
+run or the "first token" of a replayed prefill. Bounded consecutive
+retries, then the engine goes ``dead``. A step watchdog turns device
+stalls into loud, counted events, per-request deadlines shed queue-side
+before work is wasted, and ``drain()`` closes admissions and settles
+the engine to idle — the health machine
+(``healthy/degraded/draining/dead``) exports all of it as a live gauge.
+
 Tensor parallelism: pass a mesh with a 'model' axis — params shard by
 DEFAULT_TP_RULES (parallel/mesh.py), the KV pool shards its heads dim to
 match, and every program pins its out_shardings so the cache layout
@@ -61,6 +78,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.inference.config import InferenceConfig
+from deepspeed_tpu.inference.faults import FaultInjector
+from deepspeed_tpu.inference.resilience import (
+    EngineDeadError,
+    EngineDraining,
+    HealthState,
+    NumericsError,
+    StepWatchdog,
+    fatal_step_errors,
+)
 from deepspeed_tpu.inference.kv_pool import (
     cache_view,
     harvest_snapshot,
@@ -450,12 +476,12 @@ class InferenceEngine(object):
         slack = config.prefill_chunk if config.chunked_prefill else 0
         if self._spec is not None:
             slack = max(slack, config.spec_k + 1)
-        pool = init_pool(self._gcfg, config.max_slots, config.max_len,
-                         slack=slack)
-        if mesh is not None and mesh_lib.mp_size(mesh) > 1:
+        self._slack = slack
+        self._tp = mesh is not None and mesh_lib.mp_size(mesh) > 1
+        pool = self._build_pool()
+        if self._tp:
             param_sh, _, _ = mesh_lib.zero_shardings(mesh, params, stage=0)
             params = jax.tree_util.tree_map(jax.device_put, params, param_sh)
-            pool = shard_pool(mesh, pool, self._gcfg.n_head)
             pool_out = pool_shardings(mesh, pool, self._gcfg.n_head)
             rep = mesh_lib.replicated(mesh)
             prefill_out = (pool_out, rep)
@@ -498,7 +524,25 @@ class InferenceEngine(object):
         self.timers = SynchronizedWallClockTimer(registry=self.telemetry)
         self.counters = _CounterBank(self.telemetry, (
             "tokens_out", "chunks", "prefills", "prefill_tokens",
-            "requests_completed", "occupied_slot_steps", "slot_steps"))
+            "requests_completed", "occupied_slot_steps", "slot_steps",
+            # Resilience counters (docs/RESILIENCE.md). deadline_sheds
+            # and faults_injected are get-or-create by name, so the
+            # scheduler's and injector's handles are these same objects.
+            "faults_injected", "recoveries", "requests_replayed",
+            "deadline_sheds", "step_stalls"))
+        # Resilience: health machine (exports the ``health_state`` live
+        # gauge), step watchdog, recovery bookkeeping. The fault
+        # injector stays None unless inject_faults() arms one — every
+        # hot-path hook is a single ``is not None`` test when off.
+        self._health = HealthState(self.telemetry)
+        self._watchdog = StepWatchdog(config.step_budget_s, self._on_stall)
+        self._injector = None
+        self._fatal = fatal_step_errors()
+        self._recovery_streak = 0
+        self._recovery_seconds = self.telemetry.histogram("recovery_seconds")
+        # One record per recovery: absolute t_start/t_end, duration,
+        # error, replay count — the chaos loadgen's SLO-impact windows.
+        self.recovery_log = []
         # Live gauges: sampled at read (scrape) time, zero hot-path cost.
         self.telemetry.gauge("queue_depth").set_fn(
             lambda: len(self._scheduler.queue))
@@ -535,17 +579,170 @@ class InferenceEngine(object):
             return _NULL_CTX
         return annotate(name)
 
+    # --------------------------------------------------------- resilience
+
+    def _build_pool(self):
+        """THE pool construction path — engine init and crash recovery
+        both come through here, so a rebuilt pool has exactly the
+        shapes/dtypes/shardings the programs were traced with and the
+        jit cache serves it untouched: recovery never recompiles
+        (the recovery invariant's compile_count clause)."""
+        pool = init_pool(self._gcfg, self.config.max_slots,
+                         self.config.max_len, slack=self._slack)
+        if self._tp:
+            pool = shard_pool(self.mesh, pool, self._gcfg.n_head)
+        return pool
+
+    def _on_stall(self, budget_s):
+        """Watchdog trip — runs on the TIMER THREAD while the step is
+        still (possibly forever) executing, so: signal only. The step
+        itself cannot be preempted host-side; ``run(timeout_s)`` and
+        the loadgen max_steps backstop own loop-level escape."""
+        self.counters["step_stalls"] += 1
+        logger.warning(
+            "inference.watchdog: step still running past its %.3fs budget "
+            "— device stall? (%d running, %d queued; health -> degraded)",
+            budget_s, len(self._scheduler.running),
+            len(self._scheduler.queue))
+        if self._health.state == "healthy":
+            self._health.to("degraded")
+
+    @property
+    def health(self):
+        """Current health state string (``healthy/degraded/draining/
+        dead``); the ``health_state`` telemetry gauge exports its index
+        live."""
+        return self._health.state
+
+    def inject_faults(self, plan):
+        """Arm a faults.FaultPlan; steps count from here, so a plan
+        armed mid-run (the loadgen chaos mode) fires relative to the
+        arming point. Requires ``inference.fault_injection=True`` — the
+        explicit chaos switch — and replaces any previous injector.
+        Returns the armed FaultInjector (chaos harnesses introspect
+        ``exhausted()``)."""
+        if not self.config.fault_injection:
+            raise ValueError(
+                "inject_faults() requires inference.fault_injection=True "
+                "at engine construction — chaos must be switched on "
+                "explicitly, never ambient")
+        self._injector = FaultInjector(plan, registry=self.telemetry)
+        return self._injector
+
+    def _check_harvest(self, toks, valid):
+        """Harvest validity: every VALID lane must hold a real token id
+        (>= 0 — argmax/categorical over finite logits cannot produce a
+        negative). A violation means the device returned garbage (NaN
+        logits being the classic cause) and raises NumericsError BEFORE
+        any corrupt token reaches a request — the whole step's harvest
+        is discarded and recovery replays it bit-identically. Cost: one
+        vectorized compare over the [chunk, slots(, lanes)] host
+        arrays, noise next to the harvest transfer itself."""
+        if valid.any() and int(toks[valid].min()) < 0:
+            raise NumericsError(
+                "harvest validity check failed: negative token id in a "
+                "valid lane — device returned garbage (NaN logits?); "
+                "discarding this step's harvest and recovering")
+
+    def _replay_requests(self, reqs):
+        """Rewrite requeued requests for bit-identical replay: a request
+        with prompt length P that had emitted m tokens re-prefills
+        prompt + those m tokens (none is EOS — it would have completed)
+        with budget max_new - m. Its re-sampled "first token" is drawn
+        at absolute position P+m — exactly where the original run drew
+        token m+1 — and the positional fold_in(seed, pos) rng keys every
+        draw on (seed, position) alone, so greedy AND sampled streams
+        resume on the original trajectory. P+m + (max_new-m) == P +
+        max_new, so the admission-time max_len bound still holds.
+        Mid-prefill requests (m == 0) simply replay their prompt."""
+        for req in reqs:
+            m = len(req.tokens)
+            if m == 0:
+                continue
+            req.prompt = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)])
+            req.max_new_tokens -= m
+
+    def _recover(self, exc):
+        """Crash-only recovery from a fatal step error: the pool was
+        donated into the failed call, so device state is LOST by
+        definition — rebuild it (same shapes: no recompile), requeue
+        every in-flight request ahead of the queue, and rewrite each
+        for replay. Bounded: ``recovery_max_retries`` CONSECUTIVE
+        failures (a clean step resets the streak) transition to dead
+        and re-raise as EngineDeadError."""
+        t0 = time.time()
+        self._recovery_streak += 1
+        in_flight = len(self._scheduler.running)
+        if self._recovery_streak > self.config.recovery_max_retries:
+            self._health.to("dead")
+            raise EngineDeadError(
+                "inference engine dead: {} consecutive step failures "
+                "exceeded recovery_max_retries={} ({} requests were in "
+                "flight); last error: {}: {}".format(
+                    self._recovery_streak,
+                    self.config.recovery_max_retries, in_flight,
+                    type(exc).__name__, exc)) from exc
+        if self._health.state == "healthy":
+            self._health.to("degraded")
+        logger.warning(
+            "inference.recover: fatal step error (%s: %s) — rebuilding "
+            "device state, replaying %d in-flight request(s) "
+            "(attempt %d/%d)", type(exc).__name__, exc, in_flight,
+            self._recovery_streak, self.config.recovery_max_retries)
+        if self.config.recovery_backoff_s:
+            time.sleep(self.config.recovery_backoff_s *
+                       self._recovery_streak)
+        self._pool = self._build_pool()
+        replayed = self._scheduler.requeue_running()
+        self._replay_requests(replayed)
+        self.counters["recoveries"] += 1
+        self.counters["requests_replayed"] += len(replayed)
+        t1 = time.time()
+        self._recovery_seconds.observe(t1 - t0)
+        self.recovery_log.append({
+            "t_start": t0, "t_end": t1,
+            "duration_s": round(t1 - t0, 6),
+            "error": "{}: {}".format(type(exc).__name__, exc),
+            "replayed": len(replayed),
+            "attempt": self._recovery_streak,
+        })
+        self.tracer.span("engine/recovery", t0, t1,
+                         replayed=len(replayed),
+                         error=type(exc).__name__)
+        return []
+
     # ------------------------------------------------------------- submit
 
     def submit(self, prompt, max_new_tokens=None, temperature=0.0,
-               top_k=None, eos_token_id=None, seed=0, spec_decode=None):
+               top_k=None, eos_token_id=None, seed=0, spec_decode=None,
+               deadline_ms=None):
         """Queue one request; returns its Request handle. Raises
         scheduler.QueueFull past ``max_queue`` pending requests
-        (backpressure) and ValueError when the request cannot fit the
-        pool's static shapes (no silent truncation). ``spec_decode``:
-        None inherits the engine's switch, False opts this request out
-        (it cohabits the spec program with agreement vetoed — no
-        recompile), True demands an engine with speculation enabled."""
+        (backpressure — structured with queue_depth + a retry_after_s
+        hint), resilience.EngineDraining during drain() (re-route, not
+        retry), resilience.EngineDeadError on a dead engine, and
+        ValueError when the request cannot fit the pool's static shapes
+        (no silent truncation). ``spec_decode``: None inherits the
+        engine's switch, False opts this request out (it cohabits the
+        spec program with agreement vetoed — no recompile), True demands
+        an engine with speculation enabled. ``deadline_ms``: queue-side
+        expiry budget — a request still QUEUED deadline_ms after submit
+        is shed as ``expired`` (a ``deadline_sheds`` count) instead of
+        wasting a slot on an answer nobody is waiting for; once
+        admitted, it always finishes."""
+        if not self._health.accepting:
+            if self._health.state == "dead":
+                raise EngineDeadError(
+                    "submit() on a dead engine (recovery retries "
+                    "exhausted) — fail over to another replica")
+            raise EngineDraining(
+                "submit() while draining: admissions are closed while "
+                "in-flight work finishes; re-route this request "
+                "(undrain() reopens)")
+        if self._injector is not None and self._injector.admission_blocked():
+            raise self._scheduler.queue_full_error(
+                "admission blocked by injected fault (admission_block)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -568,11 +765,18 @@ class InferenceEngine(object):
                 "enable inference.spec_decode (or DS_TPU_SPEC_DECODE) at "
                 "engine construction — it sizes the KV-plane slack and the "
                 "compiled program")
+        deadline = None
+        if deadline_ms is not None:
+            if deadline_ms <= 0:
+                raise ValueError("deadline_ms must be > 0, got "
+                                 "{}".format(deadline_ms))
+            deadline = time.time() + deadline_ms / 1e3
         return self._scheduler.submit(
             prompt, int(max_new_tokens), float(temperature),
             int(top_k or 0), -1 if eos_token_id is None else int(eos_token_id),
             int(seed),
-            spec=self._spec is not None and spec_decode is not False)
+            spec=self._spec is not None and spec_decode is not False,
+            deadline=deadline)
 
     # ------------------------------------------------------------- cancel
 
@@ -614,10 +818,15 @@ class InferenceEngine(object):
 
     def _harvest_first(self, req, first, done):
         """Record a request's first token (TTFT stamps HERE — at
-        harvest, after the device sync — never at dispatch)."""
+        harvest, after the device sync — never at dispatch). On a
+        RECOVERY REPLAY the prefill lane's "first token" is really
+        token m+1 of one continuous stream: it is appended like any
+        emission, but first_token_time/TTFT stamp only once — the
+        original first token's latency is the only TTFT truth."""
         req.tokens.append(first)
-        req.first_token_time = time.time()
-        self._ttft_hist.observe(req.first_token_time - req.submit_time)
+        if req.first_token_time is None:
+            req.first_token_time = time.time()
+            self._ttft_hist.observe(req.first_token_time - req.submit_time)
         self.counters["tokens_out"] += 1
         if req.max_new_tokens <= 1 or \
                 (req.eos_token_id >= 0 and first == req.eos_token_id):
@@ -654,10 +863,40 @@ class InferenceEngine(object):
     def step(self):
         """One step boundary: admit into free slots, advance prefill and
         decode, harvest tokens, evict finished slots. Returns the
-        requests completed during this step."""
-        if self.config.chunked_prefill:
-            return self._step_chunked()
-        return self._step_legacy()
+        requests completed during this step.
+
+        The RESILIENCE envelope wraps the whole boundary: the watchdog
+        times it (a step overrunning ``step_budget_s`` trips loudly from
+        a timer thread), injected stalls burn their budget inside the
+        guard so the watchdog sees them, and any fatal step error —
+        injected, numerics, or a real XLA runtime error — lands in
+        ``_recover()`` instead of the caller's lap. A clean step resets
+        the recovery streak and clears ``degraded`` back to
+        ``healthy``."""
+        if self._health.state == "dead":
+            raise EngineDeadError(
+                "step() on a dead engine (recovery retries exhausted)")
+        inj = self._injector
+        stall = inj.stall_seconds() if inj is not None else 0.0
+        try:
+            with self._watchdog:
+                if stall > 0:
+                    time.sleep(stall)
+                if self.config.chunked_prefill:
+                    done = self._step_chunked()
+                else:
+                    done = self._step_legacy()
+        except self._fatal as exc:
+            done = self._recover(exc)
+        else:
+            self._recovery_streak = 0
+            if (self._health.state == "degraded" and stall == 0
+                    and not self._watchdog.tripped):
+                self._health.to("healthy")
+        finally:
+            if inj is not None:
+                inj.advance()
+        return done
 
     def _step_chunked(self):
         done = []
@@ -681,6 +920,11 @@ class InferenceEngine(object):
             p_done, max_new, eos, temp, top_k, seed = False, 1, -1, 0.0, 0, 0
             p_spec = False
 
+        if self._injector is not None:
+            # A "raise" fault fires HERE, in place of the program call —
+            # the pool must be presumed donated-and-lost, exactly like a
+            # real XlaRuntimeError out of the call below.
+            self._injector.maybe_raise()
         self.timers("inference/decode").start()
         with self.tracer.timed("step/mixed", prefill_tokens=n_valid), \
                 self._annotate("inference/mixed_step"):
@@ -700,6 +944,12 @@ class InferenceEngine(object):
             snap = harvest_snapshot(self._pool)
         active = snap["active"]
         self.timers("inference/decode").stop()
+        if self._injector is not None:
+            toks = self._injector.corrupt_harvest(toks, valid)
+        # Numerics gate: AFTER the device sync, BEFORE any token reaches
+        # a request — a garbage harvest is discarded whole, which is
+        # what keeps replay recovery bit-identical.
+        self._check_harvest(toks, valid)
         self.counters["chunks"] += 1
         if toks.ndim == 2:
             # Plain decode lane: one token per slot-step. Normalize to
@@ -747,6 +997,8 @@ class InferenceEngine(object):
     def _step_legacy(self):
         done = []
         admitted = []
+        if self._injector is not None:
+            self._injector.maybe_raise()
         self.timers("inference/prefill").start()
         with self.tracer.timed("step/prefill"), \
                 self._annotate("inference/prefill"):
@@ -773,6 +1025,9 @@ class InferenceEngine(object):
                 toks = np.asarray(toks)
                 valid = np.asarray(valid)
                 active = harvest_snapshot(self._pool)["active"]
+            if self._injector is not None:
+                toks = self._injector.corrupt_harvest(toks, valid)
+            self._check_harvest(toks, valid)
             self.counters["chunks"] += 1
             self.counters["occupied_slot_steps"] += int(valid.sum())
             self.counters["slot_steps"] += valid.size
@@ -792,11 +1047,16 @@ class InferenceEngine(object):
         reaching into the scheduler."""
         return self._scheduler.idle
 
-    def run(self, max_steps=None):
+    def run(self, max_steps=None, timeout_s=None):
         """Drive step() until queue and slots drain; returns completed
-        requests in completion order."""
+        requests in completion order. ``max_steps`` bounds iterations,
+        ``timeout_s`` bounds WALL CLOCK — the guard rail a stalled
+        device needs, since a wedged step makes "N more steps" a
+        meaningless promise. Either limit logs the in-flight count and
+        returns what completed; it never raises."""
         out = []
         steps = 0
+        t0 = time.time()
         while not self._scheduler.idle:
             out.extend(self.step())
             steps += 1
@@ -806,7 +1066,33 @@ class InferenceEngine(object):
                                len(self._scheduler.running) +
                                len(self._scheduler.queue))
                 break
+            if timeout_s is not None and time.time() - t0 >= timeout_s:
+                logger.warning("inference.run: timeout after %.3fs "
+                               "(%d steps) with %d requests still in "
+                               "flight", time.time() - t0, steps,
+                               len(self._scheduler.running) +
+                               len(self._scheduler.queue))
+                break
         return out
+
+    def drain(self, max_steps=None, timeout_s=None):
+        """Graceful drain: CLOSE admissions (submit() raises
+        EngineDraining; health -> ``draining``), finish every accepted
+        request — queued ones included, accepted is a promise — and
+        settle to ``engine.idle``. Returns the requests completed during
+        the drain. Admissions STAY closed afterwards (a drained replica
+        is out of rotation) until ``undrain()`` reopens them. The
+        ``max_steps``/``timeout_s`` bounds pass through to run() for
+        drains that must complete on a deadline."""
+        if self._health.state == "dead":
+            raise EngineDeadError("drain() on a dead engine")
+        self._health.to("draining")
+        return self.run(max_steps=max_steps, timeout_s=timeout_s)
+
+    def undrain(self):
+        """Reopen admissions after a drain (health -> ``healthy``).
+        Raises EngineDeadError if the engine died in the meantime."""
+        self._health.to("healthy")
 
     def generate(self, prompts, **kw):
         """Batch convenience: submit every prompt, run to completion,
@@ -888,6 +1174,14 @@ class InferenceEngine(object):
             "prefill_chunk": self.config.prefill_chunk,
             "max_active_frontier": max_active_frontier(self._pool),
             "spec_decode": self._spec is not None,
+            # Resilience: health is a state fact (never windowed); the
+            # counters window like everything else.
+            "health": self._health.state,
+            "faults_injected": c.window("faults_injected"),
+            "recoveries": c.window("recoveries"),
+            "requests_replayed": c.window("requests_replayed"),
+            "deadline_sheds": c.window("deadline_sheds"),
+            "step_stalls": c.window("step_stalls"),
         }
         if self._spec is not None:
             hist = self._accept_hist - self._accept_base

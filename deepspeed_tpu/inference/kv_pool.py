@@ -75,6 +75,14 @@ Layout invariants the flash-decode kernel
 - frontiers only move via the jitted programs (prefill sets, decode
   advances by S); host code never writes ``pos`` directly, which is what
   makes ``max_active_frontier`` a safe work-bound hint between chunks.
+
+CRASH-ONLY: the pool is DISPOSABLE state (docs/RESILIENCE.md). The
+durable truth about every request lives host-side in the scheduler's
+records; on a fatal step error the engine throws the pool away and
+calls ``init_pool`` again — same config, same shapes, so the jitted
+step program is a cache hit and ``compile_count`` does not move. Never
+add pool state that cannot be reconstructed from (config, request
+records): it would silently break request-level recovery.
 """
 
 import jax

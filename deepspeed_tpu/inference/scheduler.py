@@ -12,8 +12,15 @@ request takes which free slot" (FIFO), "whose prompt chunk rides the
 next step" (FIFO among prefilling slots), and "when" (every step).
 
 Request phases: ``queued -> prefilling -> decoding -> done`` (or
-``cancelled`` from any live phase). The legacy whole-prompt prefill
-path passes through ``prefilling`` for exactly one engine step.
+``cancelled`` from any live phase, or ``expired`` from ``queued`` when
+a request's deadline passes before admission). The legacy whole-prompt
+prefill path passes through ``prefilling`` for exactly one engine step.
+
+Recovery (docs/RESILIENCE.md) adds one extra move: after a fatal step
+error the engine calls ``requeue_running()`` — every in-flight request
+returns to the FRONT of the queue in rid (= admission) order, to be
+re-admitted and replayed against a rebuilt KV pool. The request records
+here are the durable truth that makes the device state disposable.
 
 Timestamps are stamped here (submit / admit / first token / finish) so
 the serving benchmark and the engine's metrics read one source of truth.
@@ -32,7 +39,16 @@ import time
 
 class QueueFull(RuntimeError):
     """Raised by submit() when the pending queue is at max_queue — the
-    backpressure signal for upstream callers (shed load or retry)."""
+    backpressure signal for upstream callers. STRUCTURED: carries the
+    queue depth at rejection and a ``retry_after_s`` hint derived from
+    the recent completions rate (seconds until one queue position
+    plausibly frees; None before enough completions exist to estimate),
+    so callers can implement real backoff instead of blind retry."""
+
+    def __init__(self, message, queue_depth=None, retry_after_s=None):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.retry_after_s = retry_after_s
 
 
 class Request(object):
@@ -41,10 +57,10 @@ class Request(object):
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature", "top_k",
                  "eos_token_id", "seed", "spec", "tokens", "slot", "phase",
                  "cursor", "submit_time", "admit_time", "first_token_time",
-                 "finish_time")
+                 "finish_time", "deadline", "replays")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature, top_k,
-                 eos_token_id, seed, spec=False):
+                 eos_token_id, seed, spec=False, deadline=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -69,6 +85,16 @@ class Request(object):
         self.admit_time = None
         self.first_token_time = None
         self.finish_time = None
+        # Absolute wall-clock expiry (None: no deadline). Checked QUEUE-
+        # side at each admission round: a request whose deadline passes
+        # before it reaches a slot is shed as ``expired`` — once work is
+        # admitted, it finishes (mid-stream abandonment is cancel()'s
+        # job, a caller decision).
+        self.deadline = deadline
+        # Times this request was re-admitted by recovery (replay). The
+        # emitted stream stays one stream across replays — tokens only
+        # ever grow.
+        self.replays = 0
 
     @property
     def done(self):
@@ -91,17 +117,51 @@ class Scheduler(object):
         self.tracer = tracer
         self._queue_wait = (registry.histogram("queue_wait_seconds")
                             if registry is not None else None)
+        self._deadline_sheds = (registry.counter("deadline_sheds")
+                                if registry is not None else None)
+        # Recent completion timestamps — the retry_after_s estimator's
+        # evidence. Bounded: backpressure hints need recency, not
+        # history.
+        self._finish_times = collections.deque(maxlen=32)
+        # True once any queued request carries a deadline: admissions()
+        # skips the expiry scan entirely on deadline-free workloads.
+        self._has_deadlines = False
 
     # ------------------------------------------------------------ submit
 
+    def retry_after_s(self):
+        """Backpressure hint: estimated seconds until one queue position
+        frees, from the recent completions rate (None before two recent
+        completions exist — no rate, no guess)."""
+        if len(self._finish_times) < 2:
+            return None
+        span = self._finish_times[-1] - self._finish_times[0]
+        if span <= 0:
+            return None
+        rate = (len(self._finish_times) - 1) / span
+        return round(1.0 / rate, 4)
+
+    def queue_full_error(self, reason=None):
+        """The structured QueueFull for the CURRENT queue state — also
+        built by the engine for admission-pressure sheds (injected
+        faults, drain) so every shed carries the same backpressure
+        fields."""
+        depth = len(self.queue)
+        hint = self.retry_after_s()
+        msg = reason or ("inference queue is full ({} pending); retry "
+                         "later or raise inference.max_queue".format(depth))
+        if hint is not None:
+            msg += " (retry_after_s hint: {})".format(hint)
+        return QueueFull(msg, queue_depth=depth, retry_after_s=hint)
+
     def submit(self, prompt, max_new_tokens, temperature, top_k,
-               eos_token_id, seed, spec=False):
+               eos_token_id, seed, spec=False, deadline=None):
         if len(self.queue) >= self.max_queue:
-            raise QueueFull(
-                "inference queue is full ({} pending); retry later or "
-                "raise inference.max_queue".format(len(self.queue)))
+            raise self.queue_full_error()
         req = Request(next(self._ids), prompt, max_new_tokens, temperature,
-                      top_k, eos_token_id, seed, spec)
+                      top_k, eos_token_id, seed, spec, deadline=deadline)
+        if deadline is not None:
+            self._has_deadlines = True
         self.queue.append(req)
         return req
 
@@ -109,6 +169,34 @@ class Scheduler(object):
 
     def free_slot_ids(self):
         return [s for s in range(self.num_slots) if s not in self.running]
+
+    def expire_deadlines(self, now=None):
+        """QUEUE-side deadline expiry: shed every queued request whose
+        deadline has passed (phase ``expired``, counted as a
+        ``deadline_sheds``). Runs at each admission round — a deadline
+        is a promise about WAITING, checked at the only point waiting
+        can end. Returns the expired requests. Free on deadline-free
+        workloads (one bool test)."""
+        if not self._has_deadlines:
+            return []
+        now = time.time() if now is None else now
+        expired = [r for r in self.queue
+                   if r.deadline is not None and now >= r.deadline]
+        for req in expired:
+            self.queue.remove(req)
+            req.phase = "expired"
+            req.finish_time = now
+            self.completed[req.rid] = req
+            if self._deadline_sheds is not None:
+                self._deadline_sheds.inc()
+            if self.tracer is not None:
+                self.tracer.instant("request/expired", tid=req.rid,
+                                    rid=req.rid,
+                                    waited_s=round(now - req.submit_time, 4))
+                self.tracer.span("request", req.submit_time, req.finish_time,
+                                 tid=req.rid, rid=req.rid, tokens=0,
+                                 phase="expired")
+        return expired
 
     def admissions(self):
         """FIFO: pop (request, slot) pairs for every free slot while the
@@ -119,18 +207,25 @@ class Scheduler(object):
         same point whichever program runs — the windowed queue-wait
         curve is comparable across configs. Called by the engine ONLY at
         step boundaries — the device programs never see a mid-step batch
-        change."""
+        change. Expired-deadline requests are shed before slots are
+        filled; a replayed request (recovery re-admission) keeps its
+        FIRST admit_time, so queue-wait is observed exactly once per
+        request."""
+        self.expire_deadlines()
         pairs = []
         for slot in self.free_slot_ids():
             if not self.queue:
                 break
             req = self.queue.popleft()
+            first_admission = req.admit_time is None
             req.slot = slot
             req.phase = "prefilling"
             req.cursor = 0
-            req.admit_time = time.time()
             self.running[slot] = req
             pairs.append((req, slot))
+            if not first_admission:
+                continue  # replay re-admission: stats already stamped
+            req.admit_time = time.time()
             if self._queue_wait is not None:
                 self._queue_wait.observe(req.admit_time - req.submit_time)
             if self.tracer is not None:
@@ -174,6 +269,7 @@ class Scheduler(object):
         req.phase = "done"
         req.slot = None
         self.completed[req.rid] = req
+        self._finish_times.append(req.finish_time)
         if self.tracer is not None:
             if req.first_token_time is not None:
                 self.tracer.span("request/decode", req.first_token_time,
@@ -209,6 +305,34 @@ class Scheduler(object):
                              tid=req.rid, rid=req.rid,
                              tokens=len(req.tokens), phase="cancelled")
         return True
+
+    # ---------------------------------------------------------- recovery
+
+    def requeue_running(self):
+        """Crash-only recovery (docs/RESILIENCE.md): pull EVERY in-flight
+        request out of its slot and push all of them back onto the FRONT
+        of the queue in rid (= original admission) order, ahead of
+        never-admitted work. The engine calls this after a fatal step
+        error — device state is being rebuilt, so each request restarts
+        prefill from cursor 0; the ENGINE rewrites its prompt to
+        prompt + tokens-emitted-so-far first, which is what makes the
+        replayed stream bit-identical (the positional fold_in(seed, pos)
+        rng names every draw by absolute position — see
+        engine._replay_requests). Returns the requeued requests in rid
+        order."""
+        reqs = sorted(self.running.values(), key=lambda r: r.rid)
+        self.running.clear()
+        for req in reversed(reqs):
+            req.slot = None
+            req.phase = "queued"
+            req.cursor = 0
+            req.replays += 1
+            self.queue.appendleft(req)
+            if self.tracer is not None:
+                self.tracer.instant("request/replayed", tid=req.rid,
+                                    rid=req.rid, replay=req.replays,
+                                    tokens=len(req.tokens))
+        return reqs
 
     @property
     def idle(self):

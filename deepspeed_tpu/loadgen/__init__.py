@@ -8,7 +8,10 @@ asks it the questions that matter under LOAD:
   prompt+output length mixes, JSONL trace replay.
 - ``SustainedRunner`` (runner.py): open-loop driver — submits on the
   workload's schedule regardless of backlog, records QueueFull sheds as
-  signal, ticks a ``TimeseriesCollector`` into per-window curves.
+  signal, ticks a ``TimeseriesCollector`` into per-window curves. Chaos
+  mode (``chaos_plan``/``chaos_after_s``) arms a fault plan mid-run and
+  the report grows a ``chaos`` section — recovery time, requests lost,
+  SLO attainment during vs outside recovery (docs/RESILIENCE.md).
 - ``SLO`` / ``evaluate`` (slo.py): TTFT/ITL budgets, attainment, and
   goodput (tokens from SLO-meeting requests per second per chip).
 - ``build_report`` / ``saturation_sweep`` / ``regression_gate``
@@ -17,8 +20,9 @@ asks it the questions that matter under LOAD:
   run's own per-window variance.
 
 ``bench.py --sustained`` wires the whole stack end to end (a ``--smoke``
-variant runs on CPU in CI); docs/BENCHMARKING.md is the methodology
-page.
+variant runs on CPU in CI) and ``bench.py --chaos-smoke`` does the same
+with one injected fatal step fault, asserting the recovery invariant;
+docs/BENCHMARKING.md is the methodology page.
 """
 
 from deepspeed_tpu.loadgen.report import (

@@ -24,7 +24,9 @@ import math
 
 from deepspeed_tpu.loadgen import slo as slo_mod
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2  # v2: + chaos section (recovery/requests_lost) and
+# per-sample terminal phase — additive, but comparisons across versions
+# deserve the gate's schema caveat.
 
 # Gate polarity: which direction is a REGRESSION for each report
 # metric. Lower-is-better latencies only fail when they grow;
@@ -85,6 +87,42 @@ def _percentile(vals, p):
     return s[min(int(len(s) * p / 100.0), len(s) - 1)]
 
 
+def _chaos_section(result, slo):
+    """Fold the run's recovery facts into the report's ``chaos``
+    section. Present on every report (stable schema — a fault-free run
+    shows zeros), load-bearing on chaos runs: ``requests_lost`` is the
+    recovery invariant's bottom line (MUST be 0), ``recovery_time_s``
+    the total wall clock spent rebuilding, and the attainment split —
+    requests whose lifespan overlapped a recovery interval vs the rest —
+    is the SLO price of surviving the fault, separated from steady-state
+    quality instead of smeared over the whole run."""
+    recovery = list(getattr(result, "recovery", []) or [])
+    touched, untouched = [], []
+    for s in result.samples:
+        if s["shed"] or s["e2e_s"] is None:
+            continue
+        start, end = s["arrival_s"], s["arrival_s"] + s["e2e_s"]
+        hit = any(start <= r["t_end_s"] and end >= r["t_start_s"]
+                  for r in recovery)
+        (touched if hit else untouched).append(s)
+
+    def _att(rows):
+        if not rows:
+            return None
+        return sum(1 for s in rows if slo.meets(s)) / len(rows)
+
+    return {
+        "requests_lost": int(getattr(result, "requests_lost", 0)),
+        "faults_injected": int(getattr(result, "faults_injected", 0)),
+        "recoveries": len(recovery),
+        "recovery_time_s": round(sum(r["duration_s"] for r in recovery), 6),
+        "recovery_intervals": recovery,
+        "requests_during_recovery": len(touched),
+        "slo_attainment_during_recovery": _att(touched),
+        "slo_attainment_outside_recovery": _att(untouched),
+    }
+
+
 def build_report(spec, result, slo, chips=1, platform=None, extra=None):
     """Fold one RunResult into the report document.
 
@@ -127,6 +165,7 @@ def build_report(spec, result, slo, chips=1, platform=None, extra=None):
                 slo_section["goodput_tokens_per_sec_per_chip"],
         },
         "slo": slo_section,
+        "chaos": _chaos_section(result, slo),
         "timeseries": {
             "window_seconds": result.collector.window_seconds,
             "windows_total": result.collector._idx,

@@ -16,6 +16,18 @@ One ``TimeseriesCollector.tick()`` per loop iteration turns the run
 into per-window curves; one sample record per request (submitted or
 shed) carries the per-request view. ``loadgen/report.py`` folds both
 into the SLO report.
+
+CHAOS MODE: pass ``chaos_plan`` (an inference.faults.FaultPlan) and the
+runner arms it on the engine once ``chaos_after_s`` of run time has
+passed — faults fire MID-RUN, against a live mixed batch, which is the
+only honest way to measure recovery (a fault against an idle engine
+recovers for free). The engine needs ``fault_injection=True``; chaos
+runs want the REAL clock (the engine's recovery timestamps are
+``time.time()`` and the runner converts them to run-relative). The
+result then carries the recovery intervals and ``requests_lost`` — the
+number the recovery invariant pins at 0 — and report.py folds both
+into a ``chaos`` section with SLO attainment split during/outside
+recovery.
 """
 
 import dataclasses
@@ -39,6 +51,13 @@ class RunResult:
     completed: int
     shed: int
     tokens_out: int
+    # Chaos/recovery facts (empty/zero on fault-free runs): recovery
+    # intervals in RUN-RELATIVE seconds (t_start_s/t_end_s/duration_s +
+    # error/replayed), and accepted requests that reached NO terminal
+    # phase by run end — the recovery invariant demands 0.
+    recovery: list = dataclasses.field(default_factory=list)
+    requests_lost: int = 0
+    faults_injected: int = 0
 
 
 def _sample_row(lr, req):
@@ -56,14 +75,19 @@ def _sample_row(lr, req):
         "itl_s": None,
         "tokens_out": 0,
         "completed": False,
+        "phase": None,
     }
     if req is None:
         return row
+    row["phase"] = req.phase
     row["tokens_out"] = len(req.tokens)
     if req.first_token_time is not None:
         row["ttft_s"] = req.first_token_time - req.submit_time
     if req.finish_time is not None:
-        row["completed"] = True
+        # ``completed`` means DONE — a deadline-expired or cancelled
+        # request has a finish_time too but never delivered its answer,
+        # and must not count toward completion or SLO attainment.
+        row["completed"] = req.phase == "done"
         row["e2e_s"] = req.finish_time - req.submit_time
         if req.first_token_time is not None and len(req.tokens) > 1:
             row["itl_s"] = ((req.finish_time - req.first_token_time) /
@@ -87,12 +111,18 @@ class SustainedRunner(object):
 
     def __init__(self, engine, spec, window_seconds=1.0, max_windows=512,
                  collector=None, max_steps=None, clock=time.time,
-                 sleep=time.sleep):
+                 sleep=time.sleep, chaos_plan=None, chaos_after_s=0.0):
         self.engine = engine
         self.spec = spec
         self._clock = clock
         self._sleep = sleep
         self.max_steps = max_steps
+        # Chaos mode (module docstring): arm ``chaos_plan`` on the
+        # engine once ``chaos_after_s`` run seconds pass. Fault steps
+        # count from ARMING, so the plan is written relative to the
+        # chaos point, not the run start.
+        self.chaos_plan = chaos_plan
+        self.chaos_after_s = chaos_after_s
         self.collector = collector or TimeseriesCollector(
             engine.telemetry, window_seconds=window_seconds,
             capacity=max_windows, clock=clock)
@@ -104,8 +134,17 @@ class SustainedRunner(object):
         t0 = self._clock()
         self.collector.start(t0)
         i, steps, shed = 0, 0, 0
+        injector = None
+        recoveries_at_start = len(getattr(self.engine, "recovery_log", []))
+        counters = getattr(self.engine, "counters", None)
+        faults_at_start = (counters["faults_injected"]
+                           if counters is not None and
+                           "faults_injected" in counters else 0)
         while i < len(pending) or not self.engine.idle:
             now = self._clock() - t0
+            if (self.chaos_plan is not None and injector is None
+                    and now >= self.chaos_after_s):
+                injector = self.engine.inject_faults(self.chaos_plan)
             # Submit everything whose arrival time has passed — open
             # loop: the schedule, not the backlog, decides.
             while i < len(pending) and pending[i].arrival_s <= now:
@@ -139,6 +178,22 @@ class SustainedRunner(object):
         self.collector.sample()   # flush the tail window
         wall = self._clock() - t0
         samples = [_sample_row(lr, req) for lr, req in handles]
+        # Recovery intervals from this run only, converted to run-
+        # relative seconds (the engine stamps time.time(); chaos runs
+        # use the real clock — module docstring).
+        recovery = [
+            {"t_start_s": round(r["t_start"] - t0, 6),
+             "t_end_s": round(r["t_end"] - t0, 6),
+             "duration_s": r["duration_s"],
+             "error": r["error"], "replayed": r["replayed"]}
+            for r in getattr(self.engine, "recovery_log",
+                             [])[recoveries_at_start:]]
+        # The recovery invariant's bottom line: every ACCEPTED request
+        # must reach a terminal phase — done, or deliberately shed
+        # (expired / cancelled). Anything else was lost by the engine.
+        lost = sum(1 for _, r in handles
+                   if r is not None and r.phase not in
+                   ("done", "expired", "cancelled"))
         return RunResult(
             samples=samples,
             windows=self.collector.windows(),
@@ -147,4 +202,9 @@ class SustainedRunner(object):
             submitted=sum(1 for _, r in handles if r is not None),
             completed=sum(1 for s in samples if s["completed"]),
             shed=shed,
-            tokens_out=sum(s["tokens_out"] for s in samples))
+            tokens_out=sum(s["tokens_out"] for s in samples),
+            recovery=recovery,
+            requests_lost=lost,
+            faults_injected=(0 if counters is None or
+                             "faults_injected" not in counters else
+                             counters["faults_injected"] - faults_at_start))

@@ -246,6 +246,30 @@ def test_engine_cancel_mid_prefill_and_decoding():
     assert c.tokens == seq_greedy(model, params, short_p, 30)[:len(got)]
 
 
+def test_cancel_edge_cases_boundary_double_and_after_complete():
+    """The cancel() contract at its edges: a mid-prefill cancel landing
+    on an EXACT chunk boundary (cursor == k * prefill_chunk) frees the
+    slot cleanly; a second cancel of the same request is an idempotent
+    False; cancelling an already-completed request returns False and
+    mutates nothing."""
+    cfg, model, params = make_model()
+    eng = engine_of(model, params, max_slots=1, prefill_chunk=4)
+    exact, short = prompts_of(cfg, [12, 6])    # 12 = 3 exact chunks
+    a = eng.submit(exact, max_new_tokens=4)
+    b = eng.submit(short, max_new_tokens=5)
+    eng.step()
+    assert a.phase == "prefilling" and a.cursor == 4   # exact boundary
+    assert eng.cancel(a) is True
+    assert eng.cancel(a) is False              # double-cancel: idempotent
+    assert a.phase == "cancelled" and a.slot is None and a.tokens == []
+    eng.run()                                  # b admits into the slot
+    assert b.phase == "done"
+    assert b.tokens == seq_greedy(model, params, short, 5)
+    finish = b.finish_time
+    assert eng.cancel(b) is False              # cancel-after-complete
+    assert b.phase == "done" and b.finish_time == finish
+
+
 # ------------------------------------------------------ sampling fast path
 
 

@@ -1,0 +1,428 @@
+"""Crash-only serving (inference/resilience.py + faults.py + engine).
+
+The contract under test (docs/RESILIENCE.md):
+1. RECOVERY INVARIANT — a fatal step error mid-decode, under a MIXED
+   workload (spec + non-spec, greedy + sampled, chunked prefill in
+   flight), loses ZERO requests and every recovered stream is
+   bit-identical to the fault-free run's — the positional
+   fold_in(seed, pos) rng makes replay exact. compile_count does not
+   move: the rebuilt pool has the traced shapes, so the jit cache
+   serves it.
+2. DETECTION — a "nan" fault is caught by the harvest validity check
+   (NumericsError) BEFORE any corrupt token reaches a request; a
+   "stall" fault trips the step watchdog (counter + degraded health,
+   self-healing on the next clean step); an "admission_block" fault
+   sheds with the structured QueueFull.
+3. BOUNDS — recovery retries are bounded: persistent failure ends in
+   EngineDeadError and a TERMINAL dead state (submit/step/drain all
+   refuse; undrain cannot resurrect).
+4. DRAIN — drain() closes admissions (EngineDraining), finishes every
+   accepted request, settles to engine.idle; undrain() reopens.
+5. BACKPRESSURE — QueueFull carries queue_depth + a retry_after_s hint
+   from the recent completion rate; submit(deadline_ms=...) sheds a
+   still-queued request at expiry (phase "expired", deadline_sheds).
+6. run(timeout_s) bounds wall clock alongside max_steps.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import (
+    EngineDeadError,
+    EngineDraining,
+    Fault,
+    FaultPlan,
+    HEALTH_STATES,
+    InjectedFault,
+    NumericsError,
+    QueueFull,
+    Scheduler,
+)
+from deepspeed_tpu.inference.faults import FaultInjector
+from deepspeed_tpu.inference.resilience import (
+    HealthState,
+    StepWatchdog,
+    fatal_step_errors,
+)
+from deepspeed_tpu.telemetry import MetricsRegistry
+from tests.unit.test_chunked_prefill import (
+    engine_of,
+    make_model,
+    prompts_of,
+)
+
+# make_model() is deterministic (PRNGKey(0)) and every engine treats
+# params as read-only, so one init serves the whole module — model.init
+# is the single most expensive line in any test here.
+_MODEL = {}
+
+
+def _shared_model():
+    if "m" not in _MODEL:
+        _MODEL["m"] = make_model()
+    return _MODEL["m"]
+
+# ------------------------------------------------------------ fault plans
+
+
+def test_fault_plan_validation():
+    with pytest.raises(ValueError):
+        Fault("segfault", step=0)               # unknown kind
+    with pytest.raises(ValueError):
+        Fault("raise", step=-1)                 # negative step
+    with pytest.raises(ValueError):
+        Fault("raise", step=0, duration_steps=0)
+    with pytest.raises(ValueError):
+        Fault("raise", step=0, stall_s=1.0)     # stall_s on non-stall
+    with pytest.raises(ValueError):
+        Fault("stall", step=0, stall_s=-1.0)
+    with pytest.raises(ValueError):
+        FaultPlan(faults=())                    # empty plan
+    with pytest.raises(TypeError):
+        FaultPlan(faults=("raise",))            # not Fault instances
+    f = Fault("stall", step=2, duration_steps=3, stall_s=0.1)
+    assert not f.active_at(1) and f.active_at(2) and f.active_at(4)
+    assert not f.active_at(5)
+    plan = FaultPlan(faults=(f, Fault("raise", step=7)))
+    assert plan.active(3, "stall") == [f]
+    assert plan.active(3, "raise") == []
+
+
+def test_injector_step_counting_and_exhaustion():
+    plan = FaultPlan(faults=(Fault("raise", step=1),))
+    inj = FaultInjector(plan)
+    inj.maybe_raise()                           # step 0: nothing
+    inj.advance()
+    with pytest.raises(InjectedFault) as ei:
+        inj.maybe_raise()                       # step 1: fires
+    assert ei.value.step == 1
+    assert not inj.exhausted()
+    inj.advance()
+    assert inj.exhausted()
+    with pytest.raises(TypeError):
+        FaultInjector("not a plan")
+
+
+def test_inject_faults_requires_config_switch():
+    cfg, model, params = _shared_model()
+    eng = engine_of(model, params)              # fault_injection off
+    with pytest.raises(ValueError):
+        eng.inject_faults(FaultPlan(faults=(Fault("raise", step=0),)))
+
+
+# --------------------------------------------------- resilience primitives
+
+
+def test_health_state_machine_and_dead_is_terminal():
+    assert HEALTH_STATES == ("healthy", "degraded", "draining", "dead")
+    h = HealthState()
+    assert h.state == "healthy" and h.index == 0 and h.accepting
+    h.to("degraded")
+    assert h.accepting
+    h.to("healthy")
+    h.to("draining")
+    assert not h.accepting and h.index == 2
+    with pytest.raises(ValueError):
+        h.to("zombie")
+    h.to("dead")
+    assert not h.accepting
+    h.to("dead")                                # idempotent
+    with pytest.raises(EngineDeadError):
+        h.to("healthy")                         # no resurrection
+
+
+def test_health_gauge_exports_live_index():
+    reg = MetricsRegistry(engine="inference")
+    h = HealthState(reg)
+    assert reg.gauge("health_state").value == 0.0
+    h.to("draining")
+    assert reg.gauge("health_state").value == 2.0
+
+
+def test_step_watchdog_trips_and_rearms():
+    trips = []
+    wd = StepWatchdog(0.02, trips.append)
+    with wd:
+        time.sleep(0.08)                        # overruns the budget
+    assert wd.tripped and wd.trips == 1 and trips == [0.02]
+    with wd:
+        pass                                    # fast step: no trip
+    assert not wd.tripped and wd.trips == 1
+    off = StepWatchdog(None, trips.append)      # disabled
+    with off:
+        time.sleep(0.03)
+    assert not off.tripped
+    with pytest.raises(ValueError):
+        StepWatchdog(0.0, trips.append)
+
+
+def test_fatal_step_errors_names_the_taxonomy():
+    errs = fatal_step_errors()
+    assert InjectedFault in errs and NumericsError in errs
+    import jax
+    jax_err = getattr(jax.errors, "JaxRuntimeError", None)
+    if jax_err is not None:
+        assert jax_err in errs
+
+
+# ------------------------------------------------------ recovery invariant
+
+
+def _mixed_submit(eng, prompts):
+    """A deliberately mixed stream: spec + non-spec, greedy + sampled,
+    long + short prompts — every path through the mixed step program."""
+    return [
+        eng.submit(prompts[0], max_new_tokens=10),
+        eng.submit(prompts[1], max_new_tokens=8, temperature=0.8, seed=11),
+        eng.submit(prompts[2], max_new_tokens=12, spec_decode=False),
+        eng.submit(prompts[3], max_new_tokens=6, temperature=0.5, seed=7,
+                   spec_decode=False),
+    ]
+
+
+def _run_mixed(model, params, prompts, plan=None):
+    eng = engine_of(model, params, max_slots=3, prefill_chunk=4,
+                    spec_decode=True, spec_k=2, spec_ngram=2,
+                    fault_injection=True)
+    reqs = _mixed_submit(eng, prompts)
+    if plan is not None:
+        # Drive until at least one request is decoding, so the fault
+        # fires MID-DECODE against a live mixed batch (with 4 requests
+        # on 3 slots, some are still queued/prefilling — the fault hits
+        # every lifecycle phase at once).
+        while not any(r.phase == "decoding" for r in reqs):
+            eng.step()
+        eng.inject_faults(plan)
+    eng.run()
+    return eng, reqs
+
+
+# The fault-free reference run is identical for every fault kind —
+# compute it once and share it across the parametrizations (each
+# engine wraps the step program in its own jax.jit, so a fresh
+# reference per kind would pay a full recompile for nothing).
+_MIXED_REF = {}
+
+
+def _mixed_reference(model, params, prompts):
+    if "ref" not in _MIXED_REF:
+        _MIXED_REF["ref"] = _run_mixed(model, params, prompts)
+    return _MIXED_REF["ref"]
+
+
+@pytest.mark.parametrize("kind", ["raise", "nan"])
+def test_recovery_invariant_mixed_workload(kind):
+    """THE invariant: a fatal step error mid-decode loses nothing and
+    changes no output bit — greedy and sampled, spec and non-spec —
+    and recovery does not recompile."""
+    cfg, model, params = _shared_model()
+    prompts = prompts_of(cfg, [12, 7, 20, 5])
+    ref_eng, ref = _mixed_reference(model, params, prompts)
+    plan = FaultPlan(faults=(Fault(kind, step=0),))
+    eng, got = _run_mixed(model, params, prompts, plan=plan)
+
+    assert all(r.phase == "done" for r in got)          # zero lost
+    for r, rr in zip(got, ref):
+        assert r.tokens == rr.tokens                    # bit-identical
+    assert len(eng.recovery_log) == 1
+    rec = eng.recovery_log[0]
+    assert rec["replayed"] >= 1 and rec["duration_s"] >= 0
+    if kind == "nan":
+        assert "NumericsError" in rec["error"]
+    else:
+        assert "InjectedFault" in rec["error"]
+    assert sum(r.replays for r in got) == rec["replayed"]
+    # Recovery reused the compiled program: same count as fault-free.
+    assert eng.compile_count == ref_eng.compile_count
+    assert eng.health == "healthy" and eng.idle
+    m = eng.metrics()
+    assert m["recoveries"] == 1
+    assert m["faults_injected"] == 1
+    assert m["requests_replayed"] == rec["replayed"]
+
+
+def test_replay_preserves_budget_and_single_ttft():
+    """A replayed request re-prefills prompt+emitted with the residual
+    budget — the stream never exceeds max_new_tokens — and TTFT/queue
+    wait are stamped exactly once (first admission / first token)."""
+    cfg, model, params = _shared_model()
+    eng = engine_of(model, params, max_slots=2, prefill_chunk=4,
+                    fault_injection=True)
+    (p,) = prompts_of(cfg, [6])
+    req = eng.submit(p, max_new_tokens=20)
+    while req.phase != "decoding":
+        eng.step()
+    eng.step()
+    emitted_before = len(req.tokens)
+    assert 0 < emitted_before < 20
+    ttft = req.first_token_time
+    assert ttft is not None
+    eng.inject_faults(FaultPlan(faults=(Fault("raise", step=0),)))
+    eng.run()
+    assert req.phase == "done" and req.replays == 1
+    assert len(req.tokens) == 20                # residual budget honored
+    assert req.first_token_time == ttft         # not re-stamped on replay
+    assert req.admit_time is not None
+
+
+def test_persistent_failure_ends_dead():
+    cfg, model, params = _shared_model()
+    eng = engine_of(model, params, fault_injection=True,
+                    recovery_max_retries=1)
+    (p,) = prompts_of(cfg, [6])
+    eng.submit(p, max_new_tokens=4)
+    eng.inject_faults(FaultPlan(
+        faults=(Fault("raise", step=0, duration_steps=10),)))
+    with pytest.raises(EngineDeadError) as ei:
+        eng.run()
+    assert isinstance(ei.value.__cause__, InjectedFault)
+    assert eng.health == "dead"
+    with pytest.raises(EngineDeadError):
+        eng.submit(p)
+    with pytest.raises(EngineDeadError):
+        eng.step()
+    with pytest.raises(EngineDeadError):
+        eng.drain()
+    with pytest.raises(EngineDeadError):
+        eng.undrain()                           # dead is terminal
+
+
+def test_clean_step_resets_retry_streak():
+    """Retries are CONSECUTIVE: two separated faults with max_retries=1
+    both recover, because the clean steps between them reset the
+    streak."""
+    cfg, model, params = _shared_model()
+    eng = engine_of(model, params, fault_injection=True,
+                    recovery_max_retries=1)
+    (p,) = prompts_of(cfg, [6])
+    req = eng.submit(p, max_new_tokens=40)
+    eng.inject_faults(FaultPlan(
+        faults=(Fault("raise", step=1), Fault("raise", step=5))))
+    eng.run()
+    assert req.phase == "done" and len(eng.recovery_log) == 2
+    assert eng.health == "healthy"
+
+
+# ----------------------------------------------------- watchdog and stalls
+
+
+def test_stall_fault_trips_watchdog_then_self_heals():
+    cfg, model, params = _shared_model()
+    eng = engine_of(model, params, fault_injection=True,
+                    step_budget_s=0.05)
+    (p,) = prompts_of(cfg, [6])
+    req = eng.submit(p, max_new_tokens=12)
+    eng.inject_faults(FaultPlan(
+        faults=(Fault("stall", step=0, stall_s=0.2),)))
+    eng.step()                                  # the stalled step
+    assert eng.health == "degraded"             # watchdog fired mid-step
+    eng.step()                                  # clean step
+    assert eng.health == "healthy"              # self-healed
+    eng.run()
+    assert req.phase == "done"
+    m = eng.metrics()
+    assert m["step_stalls"] >= 1
+    assert m["health"] == "healthy"
+
+
+# ----------------------------------------------------------------- drain
+
+
+def test_drain_settles_idle_and_gates_admissions():
+    cfg, model, params = _shared_model()
+    eng = engine_of(model, params, max_slots=1)
+    short = prompts_of(cfg, [5, 7])
+    a = eng.submit(short[0], max_new_tokens=4)
+    b = eng.submit(short[1], max_new_tokens=4)  # still queued: a promise
+    done = eng.drain()
+    assert eng.idle and eng.health == "draining"
+    assert {r.rid for r in done} == {a.rid, b.rid}
+    assert a.phase == b.phase == "done"
+    with pytest.raises(EngineDraining):
+        eng.submit(short[0])                    # admissions stay closed
+    eng.undrain()
+    assert eng.health == "healthy"
+    assert eng.submit(short[0], max_new_tokens=2).rid > b.rid
+
+
+def test_run_timeout_s_bounds_wall_clock():
+    cfg, model, params = _shared_model()
+    eng = engine_of(model, params, max_slots=1)
+    (p,) = prompts_of(cfg, [5])
+    req = eng.submit(p, max_new_tokens=40)
+    out = eng.run(timeout_s=0.0)                # expires after one step
+    assert out == [] and not eng.idle and not req.done
+    eng.run()                                   # finish without limits
+    assert req.done
+
+
+# ----------------------------------------------------------- backpressure
+
+
+def test_queuefull_carries_structured_backpressure():
+    s = Scheduler(num_slots=1, max_queue=1)
+    s.submit(np.arange(4, dtype=np.int32), 4, 0.0, 0, -1, 0)
+    with pytest.raises(QueueFull) as ei:
+        s.submit(np.arange(4, dtype=np.int32), 4, 0.0, 0, -1, 0)
+    assert ei.value.queue_depth == 1
+    assert ei.value.retry_after_s is None       # no completions yet
+    # With a completion rate on record, the hint is 1/rate.
+    now = time.time()
+    s._finish_times.extend([now, now + 0.5, now + 1.0])
+    assert s.retry_after_s() == pytest.approx(0.5, abs=1e-3)
+    err = s.queue_full_error()
+    assert err.retry_after_s == pytest.approx(0.5, abs=1e-3)
+    assert "retry_after_s" in str(err)
+
+
+def test_admission_block_fault_sheds_with_structured_queuefull():
+    cfg, model, params = _shared_model()
+    eng = engine_of(model, params, fault_injection=True)
+    (p,) = prompts_of(cfg, [5])
+    inj = eng.inject_faults(FaultPlan(
+        faults=(Fault("admission_block", step=0),)))
+    with pytest.raises(QueueFull) as ei:
+        eng.submit(p, max_new_tokens=2)
+    assert ei.value.queue_depth == 0            # pressure, not depth
+    eng.step()                                  # idle step advances past
+    assert inj.exhausted()
+    req = eng.submit(p, max_new_tokens=2)       # pressure lifted
+    eng.run()
+    assert req.phase == "done"
+
+
+# -------------------------------------------------------------- deadlines
+
+
+def test_scheduler_deadline_expiry_is_queue_side_only():
+    s = Scheduler(num_slots=1, max_queue=8)
+    t = time.time()
+    a = s.submit(np.arange(4, dtype=np.int32), 4, 0.0, 0, -1, 0,
+                 deadline=t + 100.0)
+    b = s.submit(np.arange(4, dtype=np.int32), 4, 0.0, 0, -1, 0,
+                 deadline=t + 0.5)
+    s.admissions()                              # a takes the only slot
+    assert a.phase == "prefilling"
+    assert s.expire_deadlines(now=t + 0.1) == []
+    assert s.expire_deadlines(now=t + 1.0) == [b]
+    assert b.phase == "expired" and b.done and b.tokens == []
+    # a's deadline passing AFTER admission changes nothing: admitted
+    # work always finishes.
+    assert s.expire_deadlines(now=t + 200.0) == []
+    assert a.phase == "prefilling"
+
+
+def test_engine_deadline_ms_sheds_expired_queued_requests():
+    cfg, model, params = _shared_model()
+    eng = engine_of(model, params, max_slots=1)
+    long_p, short_p = prompts_of(cfg, [8, 5])
+    a = eng.submit(long_p, max_new_tokens=20)   # hogs the only slot
+    b = eng.submit(short_p, max_new_tokens=4, deadline_ms=1)
+    with pytest.raises(ValueError):
+        eng.submit(short_p, deadline_ms=0)
+    time.sleep(0.01)
+    eng.run()
+    assert a.phase == "done" and b.phase == "expired"
+    assert eng.metrics()["deadline_sheds"] == 1

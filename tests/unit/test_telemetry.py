@@ -410,6 +410,46 @@ def test_mixed_serving_workload_reports_zero_recompiles():
     assert samples[("ds_tpu_recompiles_total", lbl)] == 0
 
 
+def test_resilience_counters_and_health_gauge_export():
+    """Parser-level (docs/RESILIENCE.md): the resilience counters
+    (faults_injected / recoveries / requests_replayed / deadline_sheds /
+    step_stalls), the recovery_seconds histogram, and the LIVE
+    health_state gauge all ride the standard Prometheus exposition —
+    one registry, no parallel wiring."""
+    import time
+
+    from deepspeed_tpu.inference import Fault, FaultPlan
+
+    cfg, model, params = make_model()
+    eng = engine_of(model, params, fault_injection=True, max_slots=1)
+    long_p, short_p = prompts_of(cfg, [8, 5])
+    eng.submit(long_p, max_new_tokens=12)
+    expired = eng.submit(short_p, max_new_tokens=4, deadline_ms=1)
+    eng.inject_faults(FaultPlan(faults=(Fault("raise", step=1),)))
+    time.sleep(0.01)
+    eng.run()
+    assert expired.phase == "expired"
+    kinds, samples = _parse_prom(eng.prometheus())
+    lbl = (("engine", "inference"),)
+    assert kinds["ds_tpu_faults_injected_total"] == "counter"
+    assert kinds["ds_tpu_health_state"] == "gauge"
+    assert kinds["ds_tpu_recovery_seconds"] == "summary"
+    assert samples[("ds_tpu_faults_injected_total", lbl)] == 1
+    assert samples[("ds_tpu_recoveries_total", lbl)] == 1
+    assert samples[("ds_tpu_requests_replayed_total", lbl)] >= 1
+    assert samples[("ds_tpu_deadline_sheds_total", lbl)] == 1
+    assert samples[("ds_tpu_step_stalls_total", lbl)] == 0
+    assert samples[("ds_tpu_recovery_seconds_count", lbl)] == 1
+    assert samples[("ds_tpu_health_state", lbl)] == 0.0   # healthy again
+    eng.drain()
+    _, after = _parse_prom(eng.prometheus())
+    assert after[("ds_tpu_health_state", lbl)] == 2.0     # live: draining
+    # Counters never rewind across a metrics window reset.
+    eng.metrics(reset=True)
+    _, reset = _parse_prom(eng.prometheus())
+    assert reset[("ds_tpu_recoveries_total", lbl)] == 1
+
+
 # ---------------------------------------------------- engine integration
 
 
