@@ -1295,6 +1295,177 @@ def main_chaos(smoke=False):
     return 0
 
 
+def _measure_fleet(smoke=False):
+    """`bench.py --fleet-smoke`: the FLEET failover invariant as a
+    benchmark artifact.
+
+    A 2-replica ServingFleet (real per-replica stepping threads) serves
+    a mixed greedy/sampled/spec request stream; once replica 0 is
+    mid-stream (it owns live requests with tokens already emitted), a
+    fatal fault kills it (recovery_max_retries=0 -> dead on the first
+    failure) and its requests fail over to replica 1 with residual
+    budgets. The artifact build ASSERTS the invariant: zero requests
+    lost, every stream bit-identical to a fault-free single-engine
+    reference, the survivor's compile_count unchanged, and the fleet
+    healthy at exit — then stamps the facts machine-readable."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference import (
+        Fault,
+        FaultPlan,
+        InferenceConfig,
+        InferenceEngine,
+        ServingFleet,
+    )
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu" and not smoke
+    if on_tpu:
+        cfg = GPT2Config.gpt2_medium(dropout=0.0, use_flash_attention=True)
+        serve_cfg = {"max_slots": 8, "max_len": 512, "chunk_size": 8,
+                     "prefill_chunk": 16, "max_queue": 64,
+                     "spec_decode": True, "spec_k": 2, "spec_ngram": 2,
+                     "fault_injection": True, "recovery_max_retries": 0}
+        n_requests, max_new = 24, 48
+    else:
+        cfg = GPT2Config.tiny(dropout=0.0, use_flash_attention=False)
+        serve_cfg = {"max_slots": 2, "max_len": 64, "chunk_size": 2,
+                     "prefill_chunk": 4, "max_queue": 32,
+                     "spec_decode": True, "spec_k": 2, "spec_ngram": 2,
+                     "fault_injection": True, "recovery_max_retries": 0}
+        n_requests, max_new = 8, 8
+
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(0, cfg.vocab_size, size=(2, 16))
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(init_ids))["params"]
+
+    # The fixed request stream: greedy and sampled interleaved, a third
+    # of them opting out of speculation — the full mixed-batch surface.
+    req_rng = np.random.RandomState(11)
+    requests = [
+        {"prompt": req_rng.randint(0, cfg.vocab_size,
+                                   size=4 + (i % 5)).astype(np.int32),
+         "max_new_tokens": max_new,
+         "temperature": 0.0 if i % 2 == 0 else 0.7,
+         "seed": 1000 + i,
+         "spec_decode": (i % 3 != 0)}
+        for i in range(n_requests)]
+
+    def submit_all(target, reqs):
+        return [target.submit(r["prompt"],
+                              max_new_tokens=r["max_new_tokens"],
+                              temperature=r["temperature"],
+                              seed=r["seed"],
+                              spec_decode=r["spec_decode"])
+                for r in reqs]
+
+    # Reference: the same stream on one fault-free engine. The
+    # positional fold_in(seed, pos) rng makes every stream a pure
+    # function of (prompt, seed, params) — whatever replica, batch mix,
+    # or failover timing the fleet run sees, tokens must match this.
+    ref_engine = InferenceEngine(
+        model, params, config=InferenceConfig.from_dict(
+            dict(serve_cfg, fault_injection=False)))
+    ref_handles = submit_all(ref_engine, requests)
+    ref_engine.run()
+    reference = [list(h.tokens) for h in ref_handles]
+
+    fleet = ServingFleet(model, params, n_replicas=2,
+                         config=InferenceConfig.from_dict(serve_cfg),
+                         window_seconds=0.1, seed=0)
+    t0 = time.time()
+    wave1 = submit_all(fleet, requests[:n_requests // 2])
+
+    # Kill replica 0 MID-STREAM: wait until it owns a live request with
+    # tokens already emitted (so failover really resumes a partial
+    # stream), then arm one fatal fault. recovery_max_retries=0 turns
+    # the first failure into dead.
+    deadline = time.time() + 60.0
+    while time.time() < deadline:
+        # Replica 0 mid-stream AND the survivor already warm (its
+        # compile count is the invariant's baseline — read it after
+        # its first step, not mid-compile).
+        if (any(fr.replica_id == 0 and not fr.done and len(fr.tokens) > 0
+                for fr in wave1)
+                and fleet.compile_counts[1] >= 1):
+            break
+        time.sleep(0.001)
+    mid_stream = [
+        {"fid": fr.fid, "tokens_emitted": len(fr.tokens)}
+        for fr in wave1 if fr.replica_id == 0 and not fr.done]
+    survivor_compiles_pre = fleet.compile_counts[1]
+    fleet.inject_faults(FaultPlan(faults=(Fault("raise", step=0),)),
+                        replica=0)
+    # Second wave lands while the kill is in flight — routing must keep
+    # absorbing traffic on the survivor.
+    wave2 = submit_all(fleet, requests[n_requests // 2:])
+    handles = wave1 + wave2
+    settled = fleet.wait_idle(timeout_s=300.0)
+    wall_s = time.time() - t0
+
+    got = [list(fr.tokens) for fr in handles]
+    lost = sum(1 for fr in handles
+               if fr.phase not in ("done", "expired", "cancelled"))
+    mismatched = [i for i, (g, r) in enumerate(zip(got, reference))
+                  if g != r]
+    dead = [rep.rid for rep in fleet.replicas if not rep.alive]
+    fleet_metrics = fleet.metrics()["fleet"]
+    compile_counts = fleet.compile_counts
+    health = fleet.health
+    fleet.close()
+
+    # The invariant, asserted in the artifact's own build.
+    assert settled, "fleet did not settle idle"
+    assert lost == 0, "failover lost {} request(s)".format(lost)
+    assert not mismatched, \
+        "streams diverged from the fault-free reference: {}".format(
+            mismatched)
+    assert dead == [0], "expected exactly replica 0 dead, got {}".format(
+        dead)
+    assert fleet_metrics["failovers"] >= 1, "no request failed over"
+    assert compile_counts[1] == survivor_compiles_pre, \
+        "survivor recompiled during failover: {} -> {}".format(
+            survivor_compiles_pre, compile_counts[1])
+    assert health == "healthy", "fleet unhealthy at exit: {}".format(
+        health)
+
+    return {
+        "metric": "gpt2_{}_fleet_failover_wall_s".format(
+            "355m" if on_tpu else "tiny_smoke"),
+        "value": round(wall_s, 6),
+        "unit": "s",
+        "vs_baseline": None,
+        "extra": {
+            "platform": platform,
+            "n_replicas": 2,
+            "n_requests": n_requests,
+            "requests_lost": lost,
+            "bit_identical": not mismatched,
+            "failovers": fleet_metrics["failovers"],
+            "dead_replicas": dead,
+            "mid_stream_at_kill": mid_stream,
+            "survivor_compile_counts": {
+                k: v for k, v in compile_counts.items() if k != 0},
+            "fleet_health_at_exit": health,
+            "breaker_states": fleet_metrics["breaker_states"],
+            "serve_cfg": dict(serve_cfg),
+            "note": "replica 0 killed mid-stream; docs/RESILIENCE.md "
+                    "'Serving fleet' section is the contract",
+        },
+    }
+
+
+def main_fleet(smoke=False):
+    if not smoke:
+        _require_tpu_or_exit()
+    _emit(_measure_fleet(smoke=smoke))
+    return 0
+
+
 def main_bert(sparse=False):
     _require_tpu_or_exit()
     _measure_bert(sparse=sparse, steps=12)
@@ -1339,6 +1510,10 @@ def _dispatch(argv):
     flash_decode = False if "--no-flash-decode" in argv else None
     chunked = "--no-chunked-prefill" not in argv
     spec = "--no-spec-decode" not in argv
+    if "--fleet-smoke" in argv:
+        return main_fleet(smoke=True)
+    if "--fleet" in argv:
+        return main_fleet(smoke="--smoke" in argv)
     if "--chaos-smoke" in argv:
         return main_chaos(smoke=True)
     if "--chaos" in argv:

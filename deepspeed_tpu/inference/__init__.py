@@ -15,12 +15,20 @@ from deepspeed_tpu.inference.faults import (  # noqa: F401
     FaultPlan,
     InjectedFault,
 )
+from deepspeed_tpu.inference.fleet import (  # noqa: F401
+    FleetRequest,
+    ServingFleet,
+)
 from deepspeed_tpu.inference.kv_pool import init_pool, kv_spec  # noqa: F401
 from deepspeed_tpu.inference.resilience import (  # noqa: F401
     HEALTH_STATES,
     EngineDeadError,
     EngineDraining,
     NumericsError,
+)
+from deepspeed_tpu.inference.router import (  # noqa: F401
+    CircuitBreaker,
+    Router,
 )
 from deepspeed_tpu.inference.scheduler import (  # noqa: F401
     QueueFull,

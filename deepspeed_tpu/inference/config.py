@@ -39,6 +39,7 @@ INFERENCE_DEFAULTS = {
     "step_budget_s": None,
     "recovery_max_retries": 2,
     "recovery_backoff_s": 0.0,
+    "replica_id": None,
 }
 
 
@@ -148,6 +149,11 @@ class InferenceConfig:
     # (linear). 0 disables — tests and single-fault chaos runs recover
     # immediately.
     recovery_backoff_s: float = 0.0
+    # Identity within a ServingFleet (inference/fleet.py): stamped into
+    # telemetry const labels, QueueFull payloads, and log lines so every
+    # signal a router consumes is attributable. None for a standalone
+    # engine — no labels, identical output to pre-fleet builds.
+    replica_id: Optional[int] = None
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -181,6 +187,10 @@ class InferenceConfig:
         if self.recovery_backoff_s < 0:
             raise ValueError("inference.recovery_backoff_s must be >= 0, "
                              "got {}".format(self.recovery_backoff_s))
+        if self.replica_id is not None and self.replica_id < 0:
+            raise ValueError("inference.replica_id must be >= 0 (or None "
+                             "outside a fleet), got "
+                             "{}".format(self.replica_id))
         if self.spec_decode and not self.chunked_prefill:
             raise ValueError(
                 "inference.spec_decode=True requires chunked_prefill: "

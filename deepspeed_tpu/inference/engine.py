@@ -453,13 +453,16 @@ class InferenceEngine(object):
         # metrics() must be correct either way. ``telemetry=False``
         # disables only the optional layers: trace spans (NullRecorder)
         # and profiler annotations.
-        self.telemetry = MetricsRegistry(engine="inference")
+        labels = {"engine": "inference"}
+        if config.replica_id is not None:
+            labels["replica"] = str(config.replica_id)
+        self.telemetry = MetricsRegistry(**labels)
         self.tracer = (SpanRecorder(capacity=config.trace_ring)
                        if config.telemetry else NullRecorder())
         self._scheduler = Scheduler(
             config.max_slots, config.max_queue,
             tracer=self.tracer if config.telemetry else None,
-            registry=self.telemetry)
+            registry=self.telemetry, replica_id=config.replica_id)
 
         # Engine-lifetime speculation constant: (spec_k, spec_ngram) or
         # None. STATIC — it rides the jit static args, so the spec
@@ -1093,6 +1096,24 @@ class InferenceEngine(object):
         """Reopen admissions after a drain (health -> ``healthy``).
         Raises EngineDeadError if the engine died in the meantime."""
         self._health.to("healthy")
+
+    def close_admissions(self):
+        """Close admissions WITHOUT stepping (health -> ``draining``;
+        submit() raises EngineDraining). The fleet's building block:
+        drain() owns its own run() loop, which would race a fleet step
+        thread already driving this engine — so the fleet closes
+        admissions here and lets its thread finish the in-flight work.
+        ``undrain()`` reopens."""
+        if self._health.state == "dead":
+            raise EngineDeadError("close_admissions() on a dead engine")
+        self._health.to("draining")
+
+    def close(self):
+        """Release host-side resources: stop any armed watchdog timer.
+        Idempotent; the engine object stays readable (metrics, completed
+        requests) but must not step again. Device buffers are freed by
+        GC as usual — there is nothing to close on that side."""
+        self._watchdog.stop()
 
     def generate(self, prompts, **kw):
         """Batch convenience: submit every prompt, run to completion,

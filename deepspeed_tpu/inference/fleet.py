@@ -1,0 +1,848 @@
+"""Replicated serving fleet — N engines, one front door.
+
+PR 7 made a single engine crash-only: host-side Request records are the
+durable truth, device state is disposable, and a dead engine is a
+terminal, attributable event (EngineDeadError). This module is the step
+the ROADMAP's "serve millions of users" item actually needs: a
+``ServingFleet`` that owns N data-parallel ``InferenceEngine`` replicas
+and extends the crash-only invariant ACROSS them — when a replica dies,
+its durable request records re-submit to survivors with residual
+budgets, and every stream (greedy and sampled) completes bit-identically
+to a fault-free run, because emissions depend only on
+(prompt, seed, absolute position) via the positional ``fold_in(seed,
+pos)`` rng — never on which replica, batch composition, or chunk
+boundary produced them. Zero requests lost; survivors' compile_count
+unchanged (same request shapes -> jit cache hits).
+
+Topology: replicas are IN-PROCESS, one stepping thread each, so tier-1
+CPU tests exercise the real concurrent code path. Replica->device
+placement comes from ``parallel.mesh.replica_devices`` — on a multi-chip
+host each replica gets its own device (params ``device_put`` there, the
+engine built under ``jax.default_device``); on a single-device host
+(CPU tests) replicas share the device and the host params. Per-replica
+tensor parallelism (a mesh per replica) is out of scope here — a fleet
+replica is one device.
+
+Routing (router.py): health-weighted least-loaded over the live
+``queue_depth`` / ``slot_occupancy`` / ``health_state`` gauges, one
+circuit breaker per replica fed by structured ``QueueFull.retry_after_s``
+sheds, watchdog ``step_stalls``, and fatal-step recoveries. The fleet
+consults ``breaker.allow()`` only for replicas it actually attempts, so
+half-open probes are never burned on untried candidates.
+
+Locking discipline (the whole concurrency story, in one place):
+
+- ``rep.lock`` (one per replica) serializes EVERY call into that
+  replica's engine — submit, step, cancel, health transitions. An
+  engine is single-threaded by contract; the fleet supplies that
+  contract.
+- ``self._lock`` (fleet RLock) guards fleet bookkeeping: the request
+  table, the orphan list, failover counters.
+- ORDER: ``self._lock`` may be taken while holding a ``rep.lock``,
+  NEVER the reverse — so a submit registering its request can nest, and
+  a failover scanning the table cannot deadlock against it.
+
+Failure of a replica (recovery retries exhausted, or any unexpected
+step exception — crash-only means we don't diagnose, we fail over)
+triggers ``_failover``: every live FleetRequest owned by the dead
+replica snapshots its resubmission spec (prompt + all emitted tokens,
+residual token budget, original sampling params and seed) and joins the
+orphan list; ``_pump`` then places orphans on survivors — directly via
+the scheduler, bypassing admission health, because ACCEPTED IS A
+PROMISE: a draining survivor still takes failover work, and a full one
+is retried until a slot frees (``idle`` stays False while orphans
+exist, so drive loops keep pumping).
+"""
+
+import dataclasses
+import itertools
+import threading
+import time
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.inference.config import InferenceConfig
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.resilience import (
+    EngineDeadError,
+    EngineDraining,
+)
+from deepspeed_tpu.inference.router import CircuitBreaker, Router
+from deepspeed_tpu.inference.scheduler import QueueFull
+from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.telemetry import (
+    MergedRegistry,
+    TimeseriesCollector,
+    prometheus_text,
+)
+from deepspeed_tpu.utils.logging import logger
+
+
+class FleetRequest(object):
+    """Fleet-side handle for one submitted request — the object a
+    caller (or the loadgen runner) holds across failovers. Exposes the
+    same read surface as a scheduler Request (rid/phase/tokens/
+    submit_time/first_token_time/finish_time/done) but stitches the
+    stream across replicas: ``tokens`` is every token emitted on dead
+    prior owners plus the current owner's record, in emission order —
+    one continuous bit-identical stream."""
+
+    __slots__ = ("fid", "replica_id", "failovers", "_req", "_prior",
+                 "_submit_time", "_first_token_time", "_finish_time",
+                 "_cancelled", "_respec")
+
+    def __init__(self, fid, replica_id, req):
+        self.fid = fid
+        self.replica_id = replica_id   # current owner; None mid-failover
+        self.failovers = 0
+        self._req = req                # current engine Request record
+        self._prior = []               # tokens emitted on dead replicas
+        self._submit_time = req.submit_time
+        self._first_token_time = None  # preserved across failover
+        self._finish_time = None       # set only by orphan-cancel
+        self._cancelled = False
+        self._respec = None
+
+    # -- the Request-compatible read surface ----------------------------
+
+    @property
+    def rid(self):
+        return self.fid
+
+    @property
+    def tokens(self):
+        req = self._req
+        if req is None:
+            return list(self._prior)
+        return self._prior + list(req.tokens)
+
+    @property
+    def phase(self):
+        req = self._req
+        if req is not None:
+            return req.phase
+        return "cancelled" if self._cancelled else "queued"
+
+    @property
+    def submit_time(self):
+        return self._submit_time
+
+    @property
+    def first_token_time(self):
+        if self._first_token_time is not None:
+            return self._first_token_time
+        req = self._req
+        return None if req is None else req.first_token_time
+
+    @property
+    def finish_time(self):
+        if self._finish_time is not None:
+            return self._finish_time
+        req = self._req
+        return None if req is None else req.finish_time
+
+    @property
+    def done(self):
+        return self.finish_time is not None
+
+    # -- failover internals (called under the fleet lock) ---------------
+
+    def _orphan(self):
+        """Snapshot the resubmission spec from the (dead) owner's record
+        and detach. Residual replay is the same move PR 7's single-
+        engine ``_replay_requests`` makes, lifted across replicas: the
+        new prompt is original-prompt + every emitted token (none is
+        EOS — it would have completed), the budget shrinks by what was
+        already delivered, and sampling params + seed carry over so the
+        positional rng reproduces the remaining stream bit-identically
+        on ANY survivor."""
+        req = self._req
+        if req.first_token_time is not None and \
+                self._first_token_time is None:
+            self._first_token_time = req.first_token_time
+        emitted = [int(t) for t in req.tokens]
+        self._prior.extend(emitted)
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        if emitted:
+            prompt = np.concatenate(
+                [prompt, np.asarray(emitted, np.int32)])
+        self._respec = {
+            "prompt": prompt,
+            "max_new_tokens": req.max_new_tokens - len(emitted),
+            "temperature": req.temperature,
+            "top_k": req.top_k,
+            "eos_token_id": req.eos_token_id,
+            "seed": req.seed,
+            "spec": req.spec,
+            "deadline": req.deadline,
+        }
+        self._req = None
+        self.replica_id = None
+        self.failovers += 1
+
+    def _mark_cancelled(self, now):
+        self._cancelled = True
+        self._finish_time = now
+
+
+class _Replica(object):
+    """One engine plus its fleet-side fixtures: the serialization lock,
+    the stepping thread's wake/stop events, the circuit breaker, and
+    cached handles to the live gauges the router scores from."""
+
+    __slots__ = ("rid", "engine", "device", "breaker", "lock", "wake",
+                 "stop", "thread", "failed", "last_stalls",
+                 "last_recoveries", "_g_queue", "_g_occ")
+
+    def __init__(self, rid, engine, device, breaker):
+        self.rid = rid
+        self.engine = engine
+        self.device = device
+        self.breaker = breaker
+        self.lock = threading.Lock()
+        self.wake = threading.Event()
+        self.stop = threading.Event()
+        self.thread = None
+        self.failed = False
+        self.last_stalls = 0
+        self.last_recoveries = 0
+        self._g_queue = engine.telemetry.gauge("queue_depth")
+        self._g_occ = engine.telemetry.gauge("slot_occupancy")
+
+    # Router view (router.Router.score reads these).
+    @property
+    def queue_depth(self):
+        return self._g_queue.value
+
+    @property
+    def slot_occupancy(self):
+        return self._g_occ.value
+
+    @property
+    def max_slots(self):
+        return self.engine.config.max_slots
+
+    @property
+    def health(self):
+        return self.engine.health
+
+    @property
+    def alive(self):
+        return not self.failed and self.engine.health != "dead"
+
+
+class _FleetCounters(object):
+    """Read-only dict-shaped SUM of every replica's counter bank — the
+    same duck type as ``engine.counters`` (``in`` / ``[]`` / items), so
+    the loadgen runner's counter reads work on a fleet unchanged. Dead
+    replicas keep counting (their totals are history, not garbage)."""
+
+    __slots__ = ("_replicas",)
+
+    def __init__(self, replicas):
+        self._replicas = replicas
+
+    def _banks(self):
+        return [r.engine.counters for r in self._replicas]
+
+    def __contains__(self, name):
+        return any(name in b for b in self._banks())
+
+    def __getitem__(self, name):
+        banks = [b for b in self._banks() if name in b]
+        if not banks:
+            raise KeyError(name)
+        return sum(b[name] for b in banks)
+
+    def __iter__(self):
+        seen = set()
+        for b in self._banks():
+            for n in b:
+                if n not in seen:
+                    seen.add(n)
+                    yield n
+
+    def keys(self):
+        return list(self)
+
+    def items(self):
+        return [(n, self[n]) for n in self]
+
+
+class ServingFleet(object):
+    """N replicas, one submit()/harvest()/cancel()/drain() surface.
+
+    ``start=True`` (default) launches one daemon stepping thread per
+    replica; ``start=False`` leaves the fleet single-threaded — callers
+    drive ``step()`` themselves, which is what the deterministic routing
+    tests do (no thread is racing the load the router scores).
+
+    ``breaker_factory`` builds one CircuitBreaker per replica (tests
+    inject fake-clock breakers); ``seed`` fixes the router's tie-break
+    rng. The fleet owns a TimeseriesCollector over the merged registry
+    — its windows are the SLO evidence ``rolling_drain`` checks before
+    taking a replica out of rotation."""
+
+    def __init__(self, model, params, n_replicas=2, config=None, seed=0,
+                 window_seconds=1.0, window_capacity=512, start=True,
+                 breaker_factory=None, idle_wait_s=0.01, poll_s=0.002):
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1, got "
+                             "{}".format(n_replicas))
+        if isinstance(config, dict):
+            config = InferenceConfig.from_dict(config)
+        config = config or InferenceConfig()
+        self.config = config
+        if breaker_factory is None:
+            breaker_factory = CircuitBreaker
+        devices = mesh_lib.replica_devices(n_replicas)
+        multi_device = len(set(devices)) > 1
+        self.replicas = []
+        for i in range(n_replicas):
+            cfg = dataclasses.replace(config, replica_id=i)
+            if multi_device:
+                # Own device per replica: params land there once, and
+                # the engine's pool/programs follow via default_device.
+                p = jax.device_put(params, devices[i])
+                with jax.default_device(devices[i]):
+                    eng = InferenceEngine(model, p, config=cfg)
+                # Commit the fresh pool to its device. default_device
+                # only PLACES it there (uncommitted); the first step's
+                # output pool comes back committed, and a commitment
+                # flip on an otherwise identical argument re-keys the
+                # jit cache — a spurious second compile per replica.
+                eng._pool = jax.device_put(eng._pool, devices[i])
+            else:
+                # Single-device host (CPU tests): replicas share the
+                # device AND the host params — no copies.
+                eng = InferenceEngine(model, params, config=cfg)
+            self.replicas.append(
+                _Replica(i, eng, devices[i], breaker_factory()))
+        self.router = Router(seed=seed)
+        self.telemetry = MergedRegistry(
+            {r.rid: r.engine.telemetry for r in self.replicas})
+        self.collector = TimeseriesCollector(
+            self.telemetry, window_seconds=window_seconds,
+            capacity=window_capacity)
+        self.collector.start()
+        self.counters = _FleetCounters(self.replicas)
+        self._lock = threading.RLock()
+        self._tick_lock = threading.Lock()
+        self._fids = itertools.count()
+        self._requests = {}     # fid -> FleetRequest (until harvested)
+        self._orphans = []      # FleetRequests awaiting resubmission
+        self.failovers = 0      # requests moved off dead replicas
+        self._idle_wait_s = idle_wait_s
+        self._poll_s = poll_s
+        self._started = False
+        self._closed = False
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ threads
+
+    def start(self):
+        """Launch the per-replica stepping threads (idempotent)."""
+        if self._started or self._closed:
+            return
+        self._started = True
+        for rep in self.replicas:
+            rep.thread = threading.Thread(
+                target=self._replica_loop, args=(rep,),
+                name="ds-fleet-replica-{}".format(rep.rid), daemon=True)
+            rep.thread.start()
+
+    def _replica_loop(self, rep):
+        while not rep.stop.is_set():
+            if self._orphans:
+                self._pump()
+            progressed = self._step_replica(rep)
+            if rep.failed:
+                return  # dead is terminal; the thread's work is done
+            self._tick()
+            if not progressed:
+                rep.wake.wait(self._idle_wait_s)
+                rep.wake.clear()
+
+    def _step_replica(self, rep):
+        """One guarded engine step; returns True when work was done.
+        ANY escape from step() — EngineDeadError (recovery retries
+        exhausted) or an unexpected exception (crash-only: we fail
+        over, we don't diagnose) — fails the replica and triggers
+        failover of its live requests."""
+        dead = None
+        with rep.lock:
+            if rep.failed or rep.engine.health == "dead":
+                return False
+            if rep.engine.idle:
+                return False
+            try:
+                rep.engine.step()
+            except EngineDeadError as e:
+                dead = e
+            except Exception as e:  # noqa: BLE001 — crash-only failover
+                logger.exception(
+                    "fleet: replica %d step raised unexpectedly — "
+                    "failing it over", rep.rid)
+                dead = e
+                try:
+                    rep.engine._health.to("dead")
+                except Exception:  # noqa: BLE001 — already dead is fine
+                    pass
+            else:
+                self._observe_resilience(rep)
+        if dead is not None:
+            self._failover(rep, dead)
+            return False
+        return True
+
+    def _observe_resilience(self, rep):
+        """Feed the breaker from the engine's own resilience counters
+        (called under rep.lock, right after a step): a watchdog stall
+        or a fatal-step recovery is sickness, not load — trip
+        immediately, no failure threshold."""
+        c = rep.engine.counters
+        stalls = c["step_stalls"]
+        recoveries = c["recoveries"]
+        if stalls > rep.last_stalls or recoveries > rep.last_recoveries:
+            rep.breaker.trip()
+        rep.last_stalls = stalls
+        rep.last_recoveries = recoveries
+
+    def _tick(self):
+        # Non-blocking: whichever thread hits the window boundary first
+        # closes it; everyone else skips rather than queueing up.
+        if self._tick_lock.acquire(False):
+            try:
+                self.collector.tick()
+            finally:
+                self._tick_lock.release()
+
+    # ------------------------------------------------------------- submit
+
+    def _ordered(self, include_draining=False):
+        views = [rep for rep in self.replicas
+                 if rep.alive and (rep.engine.health in
+                                   ("healthy", "degraded")
+                                   or include_draining)]
+        return self.router.order(views)
+
+    def submit(self, prompt, **kw):
+        """Route one request to the best live replica; returns a
+        FleetRequest. Tries replicas in router order, consulting each
+        breaker only at its attempt (allow() in open state IS the
+        half-open probe — never burned on an untried candidate). Raises
+        the fleet-level analogue of the engine's admission errors:
+        QueueFull (structured: summed queue_depth, MIN retry_after
+        across shed hints and open breakers, replica_id=None) when
+        every candidate rejected; EngineDraining when every live
+        replica has admissions closed; EngineDeadError when the whole
+        fleet is dead."""
+        if self._closed:
+            raise RuntimeError("submit() on a closed fleet")
+        if self._orphans:
+            self._pump()
+        candidates = self._ordered()
+        if not candidates:
+            if any(rep.alive for rep in self.replicas):
+                raise EngineDraining(
+                    "fleet: every live replica is draining — admissions "
+                    "reopen after undrain_all()/rolling_drain()")
+            raise EngineDeadError("fleet: every replica is dead")
+        depth = 0
+        hints = []
+        for rep in candidates:
+            if not rep.breaker.allow():
+                hints.append(rep.breaker.retry_after_s())
+                continue
+            with rep.lock:
+                if rep.failed:
+                    continue
+                try:
+                    req = rep.engine.submit(prompt, **kw)
+                except QueueFull as e:
+                    rep.breaker.record_failure(e.retry_after_s)
+                    depth += e.queue_depth or 0
+                    if e.retry_after_s is not None:
+                        hints.append(e.retry_after_s)
+                    continue
+                except (EngineDraining, EngineDeadError):
+                    continue
+                rep.breaker.record_success()
+                with self._lock:
+                    fr = FleetRequest(next(self._fids), rep.rid, req)
+                    self._requests[fr.fid] = fr
+            rep.wake.set()
+            return fr
+        retry = min(hints) if hints else None
+        raise QueueFull(
+            "fleet: all {} candidate replica(s) rejected the request "
+            "(open breaker or full queue){}".format(
+                len(candidates),
+                "" if retry is None else
+                " (retry_after_s hint: {})".format(round(retry, 4))),
+            queue_depth=depth, retry_after_s=retry, replica_id=None)
+
+    # ------------------------------------------------------------ harvest
+
+    def harvest(self):
+        """Completed FleetRequests not yet harvested, completion order.
+        Harvested handles leave the fleet's table (bounded bookkeeping —
+        the caller's reference is the remaining owner); unfinished
+        requests stay tracked for failover."""
+        with self._lock:
+            done = [fr for fr in self._requests.values() if fr.done]
+            for fr in done:
+                del self._requests[fr.fid]
+        return sorted(done, key=lambda fr: fr.finish_time or 0.0)
+
+    # ------------------------------------------------------------- cancel
+
+    def cancel(self, fr):
+        """Cancel wherever the request lives RIGHT NOW: on its owning
+        replica (engine.cancel — device-side slot freeze included), on
+        a DEAD replica's scheduler (host-side only: the dead pool's
+        buffers were donated away and must not be touched), or in the
+        orphan list mid-failover. Returns False when it had already
+        finished. Retries internally if a failover moves the request
+        between the ownership read and the replica lock."""
+        while True:
+            rep_id = fr.replica_id
+            if rep_id is None:
+                with self._lock:
+                    if fr.done:
+                        return False
+                    if fr.replica_id is not None:
+                        continue  # resubmitted between read and lock
+                    if fr in self._orphans:
+                        self._orphans.remove(fr)
+                    fr._mark_cancelled(time.time())
+                    return True
+            rep = self.replicas[rep_id]
+            with rep.lock:
+                if fr.replica_id != rep_id or fr._req is None:
+                    continue  # failover moved it — retry
+                if rep.alive:
+                    return rep.engine.cancel(fr._req)
+                # Dead owner, failover not yet run: host-side cancel
+                # only (the scheduler record is durable; the pool is
+                # gone) — _failover skips finished records.
+                return rep.engine._scheduler.cancel(fr._req)
+
+    # ----------------------------------------------------------- failover
+
+    def _failover(self, rep, exc):
+        """Move every live request off a failed replica. The records
+        are durable host-side state (crash-only: PR 7) — each snapshots
+        its residual resubmission spec and joins the orphan list; then
+        one pump pass tries to place them immediately."""
+        with rep.lock:
+            with self._lock:
+                if rep.failed:
+                    return
+                rep.failed = True
+                moved = [fr for fr in self._requests.values()
+                         if fr.replica_id == rep.rid and not fr.done]
+                for fr in moved:
+                    fr._orphan()
+                self._orphans.extend(moved)
+                self.failovers += len(moved)
+        logger.warning(
+            "fleet: replica %d is dead (%s: %s) — failing over %d live "
+            "request(s) to survivors", rep.rid, type(exc).__name__, exc,
+            len(moved))
+        self._pump()
+
+    def _pump(self):
+        """Place orphaned requests on survivors. Atomically claims the
+        orphan list (so concurrent pumps from several replica threads
+        never double-submit one request), tries each orphan against
+        router-ordered survivors, and re-queues what still doesn't fit
+        — ``idle`` stays False until the list empties."""
+        with self._lock:
+            orphans, self._orphans = self._orphans, []
+        if not orphans:
+            return
+        remaining = []
+        for fr in orphans:
+            if fr._cancelled or not self._place_orphan(fr):
+                if not fr._cancelled:
+                    remaining.append(fr)
+        if remaining:
+            with self._lock:
+                self._orphans.extend(remaining)
+
+    def _place_orphan(self, fr):
+        """One placement attempt across router-ordered survivors —
+        DRAINING replicas included (accepted is a promise; a drain
+        finishes accepted work, and failover work was accepted by the
+        fleet). Submission goes straight to the survivor's scheduler:
+        health-gated admission and shape validation were already passed
+        at original submit, and the residual request can only be
+        shorter. Breakers are not consulted — an open breaker means
+        sheds, and the scheduler's QueueFull tells us that directly."""
+        spec = fr._respec
+        for rep in self._ordered(include_draining=True):
+            with rep.lock:
+                if rep.failed:
+                    continue
+                try:
+                    req = rep.engine._scheduler.submit(
+                        spec["prompt"], spec["max_new_tokens"],
+                        spec["temperature"], spec["top_k"],
+                        spec["eos_token_id"], spec["seed"],
+                        spec=spec["spec"], deadline=spec["deadline"])
+                except QueueFull:
+                    continue
+                with self._lock:
+                    fr._req = req
+                    fr.replica_id = rep.rid
+            rep.wake.set()
+            logger.info("fleet: request %d failed over to replica %d "
+                        "(%d tokens emitted, %d budget left)", fr.fid,
+                        rep.rid, len(fr._prior), spec["max_new_tokens"])
+            return True
+        return False
+
+    # ------------------------------------------------------------ driving
+
+    def step(self):
+        """One fleet 'step' for single-threaded drivers (the loadgen
+        runner, start=False tests): pump orphans, then either yield to
+        the stepping threads (started fleets) or step each replica
+        inline round-robin. Completions are read back through the
+        FleetRequest handles / harvest(), so this returns []."""
+        if self._orphans:
+            self._pump()
+        if self._started:
+            time.sleep(self._poll_s)
+            self._tick()
+            return []
+        for rep in self.replicas:
+            self._step_replica(rep)
+        self._tick()
+        return []
+
+    @property
+    def idle(self):
+        """True when nothing is queued, running, or orphaned anywhere —
+        dead replicas excluded (their live work was failed over; what
+        remains in their schedulers is history)."""
+        if self._orphans:
+            return False
+        return all(rep.failed or rep.engine.idle for rep in self.replicas)
+
+    def _wait(self, pred, timeout_s):
+        t0 = time.time()
+        while not pred():
+            if self._started:
+                if self._orphans:
+                    self._pump()
+                time.sleep(self._poll_s)
+            else:
+                self.step()
+            if timeout_s is not None and time.time() - t0 >= timeout_s:
+                return False
+        return True
+
+    def wait_idle(self, timeout_s=None):
+        """Block until the fleet settles idle (or timeout; returns
+        whether it did). With stepping threads this is a pure wait; on
+        a start=False fleet it drives step() itself."""
+        return self._wait(lambda: self.idle, timeout_s)
+
+    # -------------------------------------------------------------- drain
+
+    def drain(self, timeout_s=None):
+        """Fleet-wide graceful drain: close admissions on every live
+        replica (no stepping here — the replica threads finish the
+        in-flight work, failover orphans included), settle idle, and
+        return the completed requests (harvest()). Admissions STAY
+        closed; ``undrain_all()`` reopens."""
+        for rep in self.replicas:
+            if rep.alive:
+                with rep.lock:
+                    if rep.engine.health in ("healthy", "degraded"):
+                        rep.engine.close_admissions()
+        self._wait(lambda: self.idle, timeout_s)
+        return self.harvest()
+
+    def undrain_all(self):
+        """Reopen admissions on every drained (live) replica."""
+        for rep in self.replicas:
+            if rep.alive:
+                with rep.lock:
+                    if rep.engine.health == "draining":
+                        rep.engine.undrain()
+
+    def drain_headroom(self, rep):
+        """Can the OTHERS absorb ``rep``'s load if it leaves rotation?
+        Two pieces of evidence, both must pass: live spare capacity
+        (survivors' free slots + free queue positions vs the draining
+        replica's in-flight count) and the timeseries window (the
+        survivors' queue depth at the last window close must sit below
+        half their combined queue capacity — a fleet already backed up
+        has no drain headroom even if this instant looks clear)."""
+        others = [r for r in self.replicas
+                  if r is not rep and r.alive
+                  and r.engine.health in ("healthy", "degraded")]
+        spare = sum(
+            (r.engine.config.max_slots
+             - len(r.engine._scheduler.running))
+            + (r.engine.config.max_queue - len(r.engine._scheduler.queue))
+            for r in others)
+        inflight = (len(rep.engine._scheduler.running)
+                    + len(rep.engine._scheduler.queue))
+        queue_cap = sum(r.engine.config.max_queue for r in others)
+        # Force-close the current window so the check reads NOW, not
+        # up-to-window_seconds-stale state.
+        with self._tick_lock:
+            windowed = self.collector.sample()["metrics"]
+        window_queue = sum(
+            v for k, v in windowed.items()
+            if k.startswith("queue_depth{")
+            and "replica={}".format(rep.rid) not in k
+            and isinstance(v, (int, float)))
+        ok = (bool(others) and spare >= inflight
+              and window_queue <= queue_cap / 2.0)
+        return ok, {
+            "survivors": [r.rid for r in others],
+            "spare_capacity": spare,
+            "in_flight": inflight,
+            "windowed_survivor_queue": window_queue,
+            "survivor_queue_cap": queue_cap,
+        }
+
+    def rolling_drain(self, timeout_s=30.0, require_headroom=True):
+        """Rolling restart support: one replica at a time — verify SLO
+        headroom (drain_headroom), close its admissions, let its thread
+        finish the in-flight work, reopen, move on. A replica with no
+        headroom is SKIPPED, not forced (report says why); dead
+        replicas are skipped. Returns one report dict per replica."""
+        report = []
+        for rep in self.replicas:
+            if not rep.alive:
+                report.append({"replica": rep.rid, "drained": False,
+                               "skipped": "dead"})
+                continue
+            ok, detail = self.drain_headroom(rep)
+            if require_headroom and not ok:
+                report.append({"replica": rep.rid, "drained": False,
+                               "skipped": "no_headroom",
+                               "headroom": detail})
+                continue
+            with rep.lock:
+                rep.engine.close_admissions()
+            drained = self._wait(
+                lambda: rep.failed or rep.engine.idle, timeout_s)
+            with rep.lock:
+                if rep.alive and rep.engine.health == "draining":
+                    rep.engine.undrain()
+            report.append({"replica": rep.rid,
+                           "drained": drained and rep.alive,
+                           "headroom": detail})
+        return report
+
+    # -------------------------------------------------------------- chaos
+
+    def inject_faults(self, plan, replica=0):
+        """Arm a FaultPlan on ONE replica (chaos: kill replica
+        ``replica`` mid-run while the fleet keeps serving). Same
+        contract as engine.inject_faults — requires
+        ``fault_injection=True`` in the shared config."""
+        rep = self.replicas[replica]
+        with rep.lock:
+            return rep.engine.inject_faults(plan)
+
+    @property
+    def recovery_log(self):
+        """Every replica's recovery records merged in time order, each
+        stamped with its replica id — the loadgen runner's chaos
+        windows read this exactly like a single engine's log."""
+        out = []
+        for rep in self.replicas:
+            for rec in rep.engine.recovery_log:
+                d = dict(rec)
+                d["replica"] = rep.rid
+                out.append(d)
+        out.sort(key=lambda d: d["t_start"])
+        return out
+
+    # ------------------------------------------------------------ metrics
+
+    @property
+    def health(self):
+        """Fleet health = the best any replica offers: one healthy
+        accepting replica makes a healthy fleet (that IS the point of
+        replication); degraded-only -> degraded; live-but-closed ->
+        draining; nobody left -> dead."""
+        states = [rep.engine.health if not rep.failed else "dead"
+                  for rep in self.replicas]
+        for s in ("healthy", "degraded", "draining"):
+            if s in states:
+                return s
+        return "dead"
+
+    def metrics(self, reset=False):
+        """Aggregated fleet view + per-replica engine metrics. NOTE:
+        ``reset=True`` forwards to every engine and so touches the same
+        windows the fleet's TimeseriesCollector owns — same single-
+        window-owner caveat as a lone engine (telemetry/timeseries.py)."""
+        per_replica = {rep.rid: rep.engine.metrics(reset=reset)
+                       for rep in self.replicas}
+        agg = {}
+        for name in ("tokens_out", "requests_completed", "recoveries",
+                     "requests_replayed", "deadline_sheds", "step_stalls",
+                     "faults_injected"):
+            if name in self.counters:
+                agg[name] = self.counters[name]
+        agg.update({
+            "n_replicas": len(self.replicas),
+            "alive": sum(1 for rep in self.replicas if rep.alive),
+            "health": self.health,
+            "failovers": self.failovers,
+            "orphans": len(self._orphans),
+            "breaker_states": {rep.rid: rep.breaker.state
+                               for rep in self.replicas},
+        })
+        return {"fleet": agg, "replicas": per_replica}
+
+    def prometheus(self):
+        """One text-exposition snapshot of the WHOLE fleet: the merged
+        registry exports every replica's series side by side, each
+        carrying its ``replica`` label."""
+        return prometheus_text(self.telemetry)
+
+    @property
+    def compile_counts(self):
+        """Per-replica compiled-program counts — what the failover
+        invariant pins: killing replica K must leave every other
+        entry unchanged."""
+        return {rep.rid: rep.engine.compile_count
+                for rep in self.replicas}
+
+    # ------------------------------------------------------------ teardown
+
+    def close(self, timeout_s=5.0):
+        """Stop and JOIN every replica thread, stop every watchdog.
+        Idempotent; a closed fleet still reads (metrics, harvest) but
+        never steps or submits again. __del__ calls this so interpreter
+        exit never hangs on a fleet the test forgot."""
+        if self._closed:
+            return
+        self._closed = True
+        for rep in self.replicas:
+            rep.stop.set()
+            rep.wake.set()
+        for rep in self.replicas:
+            if rep.thread is not None:
+                rep.thread.join(timeout=timeout_s)
+        for rep in self.replicas:
+            rep.engine.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

@@ -156,8 +156,16 @@ class StepWatchdog(object):
             self._timer.start()
         return self
 
+    def stop(self):
+        """Cancel any armed timer. Idempotent and safe from any thread —
+        engine.close() and fleet teardown call it so a watchdog armed
+        around a wedged final step can never keep the interpreter alive
+        (the timer is a daemon thread regardless, but a cancelled timer
+        also never fires a late trip into a torn-down engine)."""
+        timer, self._timer = self._timer, None
+        if timer is not None:
+            timer.cancel()
+
     def __exit__(self, *exc):
-        if self._timer is not None:
-            self._timer.cancel()
-            self._timer = None
+        self.stop()
         return False

@@ -36,19 +36,30 @@ import collections
 import itertools
 import time
 
+# retry_after_s ceiling: on a cold completions window (two completions
+# minutes apart) the naive 1/rate estimate is astronomical, and router
+# backoff math multiplying it would park a replica forever. One minute
+# is long past any sane re-probe interval.
+RETRY_AFTER_CAP_S = 60.0
+
 
 class QueueFull(RuntimeError):
     """Raised by submit() when the pending queue is at max_queue — the
     backpressure signal for upstream callers. STRUCTURED: carries the
-    queue depth at rejection and a ``retry_after_s`` hint derived from
+    queue depth at rejection, a ``retry_after_s`` hint derived from
     the recent completions rate (seconds until one queue position
-    plausibly frees; None before enough completions exist to estimate),
-    so callers can implement real backoff instead of blind retry."""
+    plausibly frees; None before enough completions exist to estimate;
+    always clamped to [0, RETRY_AFTER_CAP_S] so backoff math cannot go
+    negative or absurd on a cold completions window), and the
+    ``replica_id`` of the rejecting engine (None outside a fleet) so a
+    router can attribute the shed to one breaker."""
 
-    def __init__(self, message, queue_depth=None, retry_after_s=None):
+    def __init__(self, message, queue_depth=None, retry_after_s=None,
+                 replica_id=None):
         super().__init__(message)
         self.queue_depth = queue_depth
         self.retry_after_s = retry_after_s
+        self.replica_id = replica_id
 
 
 class Request(object):
@@ -104,9 +115,14 @@ class Request(object):
 class Scheduler(object):
     """FIFO admission over a fixed slot set."""
 
-    def __init__(self, num_slots, max_queue, tracer=None, registry=None):
+    def __init__(self, num_slots, max_queue, tracer=None, registry=None,
+                 replica_id=None):
         self.num_slots = num_slots
         self.max_queue = max_queue
+        # Stamped into every QueueFull this scheduler raises so a fleet
+        # router can attribute the shed to one replica's breaker. None
+        # for a standalone engine.
+        self.replica_id = replica_id
         self.queue = collections.deque()
         self.running = {}           # slot -> Request (prefilling | decoding)
         self.completed = {}         # rid -> Request (incl. cancelled)
@@ -139,7 +155,7 @@ class Scheduler(object):
         if span <= 0:
             return None
         rate = (len(self._finish_times) - 1) / span
-        return round(1.0 / rate, 4)
+        return round(min(max(1.0 / rate, 0.0), RETRY_AFTER_CAP_S), 4)
 
     def queue_full_error(self, reason=None):
         """The structured QueueFull for the CURRENT queue state — also
@@ -152,7 +168,8 @@ class Scheduler(object):
                          "later or raise inference.max_queue".format(depth))
         if hint is not None:
             msg += " (retry_after_s hint: {})".format(hint)
-        return QueueFull(msg, queue_depth=depth, retry_after_s=hint)
+        return QueueFull(msg, queue_depth=depth, retry_after_s=hint,
+                         replica_id=self.replica_id)
 
     def submit(self, prompt, max_new_tokens, temperature, top_k,
                eos_token_id, seed, spec=False, deadline=None):

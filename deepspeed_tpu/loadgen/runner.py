@@ -111,7 +111,8 @@ class SustainedRunner(object):
 
     def __init__(self, engine, spec, window_seconds=1.0, max_windows=512,
                  collector=None, max_steps=None, clock=time.time,
-                 sleep=time.sleep, chaos_plan=None, chaos_after_s=0.0):
+                 sleep=time.sleep, chaos_plan=None, chaos_after_s=0.0,
+                 chaos_replica=None):
         self.engine = engine
         self.spec = spec
         self._clock = clock
@@ -120,9 +121,12 @@ class SustainedRunner(object):
         # Chaos mode (module docstring): arm ``chaos_plan`` on the
         # engine once ``chaos_after_s`` run seconds pass. Fault steps
         # count from ARMING, so the plan is written relative to the
-        # chaos point, not the run start.
+        # chaos point, not the run start. ``chaos_replica`` targets one
+        # replica of a ServingFleet (kill-a-replica-mid-run chaos);
+        # None keeps the single-engine call shape.
         self.chaos_plan = chaos_plan
         self.chaos_after_s = chaos_after_s
+        self.chaos_replica = chaos_replica
         self.collector = collector or TimeseriesCollector(
             engine.telemetry, window_seconds=window_seconds,
             capacity=max_windows, clock=clock)
@@ -144,7 +148,11 @@ class SustainedRunner(object):
             now = self._clock() - t0
             if (self.chaos_plan is not None and injector is None
                     and now >= self.chaos_after_s):
-                injector = self.engine.inject_faults(self.chaos_plan)
+                if self.chaos_replica is not None:
+                    injector = self.engine.inject_faults(
+                        self.chaos_plan, replica=self.chaos_replica)
+                else:
+                    injector = self.engine.inject_faults(self.chaos_plan)
             # Submit everything whose arrival time has passed — open
             # loop: the schedule, not the backlog, decides.
             while i < len(pending) and pending[i].arrival_s <= now:

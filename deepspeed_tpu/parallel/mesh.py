@@ -103,6 +103,19 @@ def default_mesh() -> Mesh:
     return build_mesh()
 
 
+def replica_devices(n: int, devices=None):
+    """Device per serving replica for a ServingFleet of ``n`` replicas
+    (inference/fleet.py): round-robin over the visible devices, so n <=
+    device_count gives each replica its own chip and n > device_count
+    packs replicas fairly. On a single-device host (CPU tests) every
+    replica shares the one device — the fleet then skips device_put
+    entirely and replicas share the host params."""
+    if n < 1:
+        raise ValueError("replica count must be >= 1, got {}".format(n))
+    devices = list(jax.devices()) if devices is None else list(devices)
+    return [devices[i % len(devices)] for i in range(n)]
+
+
 def dp_size(mesh: Mesh) -> int:
     return mesh.shape.get(DATA_AXIS, 1)
 

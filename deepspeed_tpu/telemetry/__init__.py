@@ -38,6 +38,7 @@ from deepspeed_tpu.telemetry.registry import (
     Counter,
     Gauge,
     Histogram,
+    MergedRegistry,
     MetricsRegistry,
     NullRegistry,
 )
@@ -49,6 +50,7 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "MergedRegistry",
     "MetricsRegistry",
     "NullRegistry",
     "NullRecorder",

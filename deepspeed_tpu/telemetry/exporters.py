@@ -61,10 +61,17 @@ def prometheus_text(registry):
     window resets do NOT rewind them; Prometheus rates need monotonic
     series), gauges as ``<ns>_<name>``, histograms as summaries:
     ``{quantile="0.5|0.95|0.99"}`` rows from the bounded reservoir plus
-    exact ``_sum``/``_count``."""
+    exact ``_sum``/``_count``.
+
+    Series within a family are emitted in sorted-label order, so the
+    text (and prometheus_digest) is canonical regardless of the
+    registry's internal ordering — in particular a fleet's
+    MergedRegistry produces the same digest whatever order its replica
+    registries were attached in."""
     ns = registry.namespace
     lines = []
     for name, kind, metrics in registry.collect():
+        metrics = sorted(metrics, key=lambda m: sorted(m.labels.items()))
         base = "{}_{}".format(ns, name) if ns else name
         if kind == "counter":
             lines.append("# TYPE {}_total counter".format(base))

@@ -267,6 +267,121 @@ class MetricsRegistry(object):
             m.reset_window()
 
 
+class _LabeledMetric(object):
+    """Read-only view of a child registry's metric with one label
+    injected (``replica="0"``). The metric object itself is SHARED with
+    the child — values are always live; only the label dict is copied.
+    Injection is setdefault semantics: a child that already carries the
+    label (an engine built with ``replica_id``) keeps its own value, so
+    the merge never mislabels a replica."""
+
+    __slots__ = ("_metric", "labels")
+
+    def __init__(self, metric, label, value):
+        self._metric = metric
+        merged = dict(metric.labels)
+        merged.setdefault(label, value)
+        self.labels = merged
+
+    def __getattr__(self, name):
+        return getattr(self._metric, name)
+
+
+class MergedRegistry(object):
+    """Read-only union of child registries under one label axis — the
+    fleet's aggregate view (``MergedRegistry({0: eng0.telemetry, 1:
+    eng1.telemetry})`` exports every engine series with a ``replica``
+    label). Same read surface as MetricsRegistry (collect / snapshot /
+    reset_window), so every exporter — prometheus_text, the HTTP
+    endpoint, the timeseries collector — works on a fleet unchanged.
+    Metric CREATION goes through the children, never through here:
+    counter()/gauge()/histogram() raise, because a merged metric has no
+    single owner to mutate."""
+
+    def __init__(self, children, label="replica", namespace=None):
+        # children: mapping axis value -> registry. Axis values are
+        # stringified for labels; iteration order (sorted keys) is the
+        # within-family export order.
+        self.children = dict(children)
+        self.label = label
+        regs = list(self.children.values())
+        if namespace is None:
+            namespace = regs[0].namespace if regs else "ds_tpu"
+        self.namespace = namespace
+        # Const labels common to EVERY child (same key, same value) —
+        # snapshot() elides them from keys exactly as MetricsRegistry
+        # elides its own const_labels; per-child labels (replica) stay.
+        common = None
+        for reg in regs:
+            items = set(reg.const_labels.items())
+            common = items if common is None else (common & items)
+        self.const_labels = dict(common or ())
+
+    def _no_create(self, name):
+        raise TypeError(
+            "MergedRegistry is read-only: create metric {!r} on a child "
+            "registry (it has an owner); the merge only exports".format(name))
+
+    def counter(self, name, **labels):
+        self._no_create(name)
+
+    def gauge(self, name, **labels):
+        self._no_create(name)
+
+    def histogram(self, name, reservoir_size=2048, **labels):
+        self._no_create(name)
+
+    def collect(self):
+        """Union of the children's families: (name, kind, [metric...])
+        with names sorted and each metric wrapped to carry its child's
+        axis label. A name registered as different kinds in different
+        children raises — one name, one type, fleet-wide."""
+        fams = {}
+        kinds = {}
+        for key in sorted(self.children, key=str):
+            for name, kind, metrics in self.children[key].collect():
+                prev = kinds.setdefault(name, kind)
+                if prev != kind:
+                    raise TypeError(
+                        "metric {!r} is a {} in one replica registry and "
+                        "a {} in another — one name, one type"
+                        .format(name, prev, kind))
+                fams.setdefault(name, []).extend(
+                    _LabeledMetric(m, self.label, str(key))
+                    for m in metrics)
+        for name in sorted(fams):
+            yield name, kinds[name], fams[name]
+
+    def snapshot(self, reset=False):
+        """Plain-dict view across the fleet: keys carry every non-common
+        label — ``tokens_out{replica=0}`` — with the same value
+        semantics as MetricsRegistry.snapshot. ``reset=True`` opens a
+        new window on EVERY child."""
+        out = {}
+        for name, kind, metrics in self.collect():
+            for m in metrics:
+                key = name
+                extra = {k: v for k, v in m.labels.items()
+                         if self.const_labels.get(k) != v}
+                if extra:
+                    key = "{}{{{}}}".format(name, ",".join(
+                        "{}={}".format(k, v) for k, v in sorted(
+                            extra.items())))
+                if kind == "counter":
+                    out[key] = m.window_value
+                elif kind == "gauge":
+                    out[key] = m.value
+                else:
+                    out[key] = m.stats()
+        if reset:
+            self.reset_window()
+        return out
+
+    def reset_window(self):
+        for reg in self.children.values():
+            reg.reset_window()
+
+
 class _NullMetric(object):
     """Accepts every metric call and does nothing — the telemetry-off
     stand-in (one shared instance per registry; zero allocation on the

@@ -26,6 +26,11 @@ jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", \
     "tests must run on the virtual CPU mesh, got {}".format(jax.default_backend())
 
+# NOTE: do NOT enable jax's persistent compilation cache here. The fused
+# train step embeds io_callback hosts (offload grad streaming, overflow
+# token); executables holding host callbacks don't survive the serialize/
+# deserialize round trip — a warm cache hit segfaults at execution time.
+
 import pytest  # noqa: E402
 
 

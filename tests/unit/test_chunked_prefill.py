@@ -28,16 +28,26 @@ from deepspeed_tpu.models.generation import generate
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
 
 
+_MODELS = {}
+
+
 def make_model(seed=0, **kw):
     kw.setdefault("dropout", 0.0)
     kw.setdefault("use_flash_attention", False)
     kw.setdefault("dtype", jnp.float32)  # parity is exercised in f32
-    cfg = GPT2Config.tiny(**kw)
-    model = GPT2LMHeadModel(cfg)
-    ids = np.random.RandomState(seed).randint(0, cfg.vocab_size,
-                                              size=(2, 12))
-    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))["params"]
-    return cfg, model, params
+    # Memoized: init is deterministic (PRNGKey(0)) and every inference
+    # engine treats params as read-only, so one init per config serves
+    # the whole module (and the modules importing these helpers).
+    key = (seed, tuple(sorted(kw.items(), key=lambda i: i[0])))
+    if key not in _MODELS:
+        cfg = GPT2Config.tiny(**kw)
+        model = GPT2LMHeadModel(cfg)
+        ids = np.random.RandomState(seed).randint(0, cfg.vocab_size,
+                                                  size=(2, 12))
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.asarray(ids))["params"]
+        _MODELS[key] = (cfg, model, params)
+    return _MODELS[key]
 
 
 def prompts_of(cfg, lengths, seed=3):

@@ -168,10 +168,12 @@ def test_gpt2_pipeline_compiled_matches_untied_interpreter(eight_devices):
 
 def test_gpt2_pipeline_tied_interpreter_trains(eight_devices):
     """The tied variant (TiedLayerSpec embedding reused as LM head — the
-    reference GPT2ModelPipe shape) runs on the interpreter engine."""
+    reference GPT2ModelPipe shape) runs on the interpreter engine.
+    Depth-independent (tying is about the embed/head pair), so 2 layers:
+    the multi-block-per-stage path is covered by the untied test above."""
     from deepspeed_tpu.models.gpt2 import GPT2Config, gpt2_pipeline
 
-    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=4,
+    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
                      n_head=4, dropout=0.0, use_flash_attention=False)
     model = gpt2_pipeline(cfg, num_stages=2)  # tied by default
     engine, _, _, _ = deepspeed.initialize(model=model, config_params={
@@ -233,8 +235,10 @@ def test_gpt2_pipeline_compiled_flash_matches_dense(eight_devices):
     from deepspeed_tpu.models.gpt2 import GPT2Config, gpt2_pipeline
 
     def run(flash):
+        # 2 layers: the parity under test is flash-vs-dense inside one
+        # stage's shard_map worker, independent of depth.
         cfg = GPT2Config(vocab_size=256, n_positions=128, n_embd=64,
-                         n_layer=4, n_head=4, dropout=0.0,
+                         n_layer=2, n_head=4, dropout=0.0,
                          use_flash_attention=flash)
         model = gpt2_pipeline(cfg, num_stages=2, compiled=True)
         engine, _, _, _ = deepspeed.initialize(model=model, config_params={
@@ -256,10 +260,11 @@ def test_compiled_eval_batch_deterministic_and_matches_interpreter(
     """eval_batch on the compiled engine: forward-only one-program
     schedule, deterministic under dropout, and — through a checkpoint
     interchange onto the interpreter engine — numerically equal to the
-    interpreter's eval of the same params."""
+    interpreter's eval of the same params. 2 layers: the one-program
+    eval schedule and checkpoint interchange are depth-independent."""
     from deepspeed_tpu.models.gpt2 import GPT2Config, gpt2_pipeline
 
-    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=4,
+    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
                      n_head=4, dropout=0.1, use_flash_attention=False)
 
     def mk(compiled):

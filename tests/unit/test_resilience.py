@@ -426,3 +426,41 @@ def test_engine_deadline_ms_sheds_expired_queued_requests():
     eng.run()
     assert a.phase == "done" and b.phase == "expired"
     assert eng.metrics()["deadline_sheds"] == 1
+
+
+def test_watchdog_stop_idempotent_and_engine_close():
+    """stop() disarms any pending timer from any thread, twice is fine,
+    and a stopped watchdog never fires a late trip; engine.close() is
+    the lifecycle hook that calls it (fleet teardown joins N of these),
+    close_admissions() gates submit without stepping, and both refuse
+    or no-op sanely on a dead engine."""
+    trips = []
+    wd = StepWatchdog(30.0, trips.append)
+    wd.__enter__()
+    assert wd._timer is not None
+    wd.stop()
+    wd.stop()                                   # idempotent
+    assert wd._timer is None and trips == []
+    wd.__exit__(None, None, None)               # exit after stop: no-op
+    with wd:
+        pass                                    # still usable afterwards
+    assert not wd.tripped and trips == []
+
+    cfg, model, params = _shared_model()
+    eng = engine_of(model, params)
+    (p,) = prompts_of(cfg, [5])
+    eng.close_admissions()                      # gate WITHOUT stepping
+    assert eng.health == "draining"
+    with pytest.raises(EngineDraining):
+        eng.submit(p, max_new_tokens=2)
+    eng.undrain()
+    req = eng.submit(p, max_new_tokens=2)
+    eng.run()
+    assert req.phase == "done"
+    eng.close()
+    eng.close()                                 # idempotent
+    assert eng._watchdog._timer is None
+    assert eng.metrics()["requests_completed"] == 1   # still readable
+    eng._health.to("dead")
+    with pytest.raises(EngineDeadError):
+        eng.close_admissions()

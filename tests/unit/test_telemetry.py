@@ -33,6 +33,7 @@ import numpy as np
 import pytest
 
 from deepspeed_tpu.telemetry import (
+    MergedRegistry,
     MetricsRegistry,
     NullRecorder,
     NullRegistry,
@@ -559,3 +560,73 @@ def test_import_without_extras(tmp_path):
                          text=True, timeout=120)
     assert out.returncode == 0, out.stderr
     assert out.stdout.strip().endswith("ds_tpu_ok_total 1")
+
+
+# ------------------------------------------------------ merged registry
+
+
+def test_merged_registry_replica_labeled_series_parse():
+    """The fleet's aggregate view at the PARSER level: one correctly
+    labeled series per replica per metric, kind lines intact, counter
+    semantics preserved — through the same minimal parser the plain
+    exposition test uses, so a label-merge regression fails here."""
+    regs = {}
+    for rid in (0, 1):
+        reg = MetricsRegistry(engine="inference", replica=str(rid))
+        reg.counter("tokens_out").inc(10 * (rid + 1))
+        reg.gauge("queue_depth").set(rid + 3)
+        reg.histogram("ttft").observe(0.5 * (rid + 1))
+        regs[rid] = reg
+    merged = MergedRegistry(regs)
+    kinds, samples = _parse_prom(prometheus_text(merged))
+    assert kinds["ds_tpu_tokens_out_total"] == "counter"
+    assert kinds["ds_tpu_queue_depth"] == "gauge"
+    assert kinds["ds_tpu_ttft"] == "summary"
+    for rid in (0, 1):
+        lbl = (("engine", "inference"), ("replica", str(rid)))
+        assert samples[("ds_tpu_tokens_out_total", lbl)] == 10 * (rid + 1)
+        assert samples[("ds_tpu_queue_depth", lbl)] == rid + 3
+        assert samples[("ds_tpu_ttft_count", lbl)] == 1
+    # Children WITHOUT a replica const label get one injected from the
+    # merge axis — the fleet works with pre-PR-8 engine registries too.
+    plain = {7: MetricsRegistry(engine="inference")}
+    plain[7].counter("tokens_out").inc(5)
+    _, injected = _parse_prom(prometheus_text(MergedRegistry(plain)))
+    lbl = (("engine", "inference"), ("replica", "7"))
+    assert injected[("ds_tpu_tokens_out_total", lbl)] == 5
+    # snapshot() keys carry the per-replica label; the common const
+    # label (engine) is elided exactly like MetricsRegistry does.
+    snap = merged.snapshot()
+    assert snap["tokens_out{replica=0}"] == 10
+    assert snap["tokens_out{replica=1}"] == 20
+    assert not any("engine=" in k for k in snap)
+
+
+def test_merged_registry_read_only_escaping_and_kind_conflict():
+    bad = MetricsRegistry(engine="inference", replica='a"b\\c\n')
+    bad.counter("tokens_out").inc(1)
+    merged = MergedRegistry({0: bad})
+    text = prometheus_text(merged)
+    # The exporter's escaping survives the merge's label wrapping:
+    # backslash, quote, and newline all escape inside the label value.
+    assert 'replica="a\\"b\\\\c\\n"' in text
+    assert "\n\n" not in text.strip()
+    with pytest.raises(TypeError):
+        merged.counter("x")
+    with pytest.raises(TypeError):
+        merged.gauge("x")
+    with pytest.raises(TypeError):
+        merged.histogram("x")
+    # One name, one kind — fleet-wide.
+    a, b = MetricsRegistry(replica="0"), MetricsRegistry(replica="1")
+    a.counter("depth").inc(1)
+    b.gauge("depth").set(2)
+    with pytest.raises(TypeError):
+        list(MergedRegistry({0: a, 1: b}).collect())
+    # reset_window() reaches every child (counter windows reopen;
+    # totals never rewind).
+    merged.reset_window()
+    _, after = _parse_prom(prometheus_text(merged))
+    assert after[("ds_tpu_tokens_out_total",
+                  (("engine", "inference"),
+                   ("replica", 'a\\"b\\\\c\\n')))] == 1
