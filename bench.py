@@ -823,7 +823,8 @@ def _decode_attention_probe(engine, reps=10, s=1):
 
 
 def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
-                     spec_decode=True):
+                     spec_decode=True, int8_kv=True, prefix_cache=True,
+                     host_offload=True):
     """Continuous-batching serving benchmark (deepspeed_tpu/inference/).
 
     A synthetic Poisson request stream plays against the slotted engine:
@@ -846,7 +847,12 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
     metrics attribute any throughput delta to draft acceptance. The
     prompts are REPETITION-HEAVY (each tiles its own short phrase) — the
     workload where prompt-lookup drafting has matches to find; the
-    non-spec A/B serves the identical stream."""
+    non-spec A/B serves the identical stream. ``int8_kv`` /
+    ``prefix_cache`` / ``host_offload`` enable the KV memory hierarchy
+    (docs/INFERENCE.md); the ``--no-int8-kv`` / ``--no-prefix-cache`` /
+    ``--no-host-offload`` A/Bs suffix the metric name so hierarchy-on
+    and hierarchy-off series never mix. The hierarchy rides the chunked
+    path only — the legacy A/B runs with it off."""
     import jax
 
     import deepspeed_tpu as deepspeed
@@ -875,6 +881,18 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
     serve_cfg["chunked_prefill"] = chunked_prefill
     spec_on = bool(spec_decode and chunked_prefill)
     serve_cfg["spec_decode"] = spec_on
+    # KV hierarchy (prefix cache / host offload require the chunked
+    # path, same gating as speculation; int8 is path-independent).
+    int8_on = bool(int8_kv)
+    prefix_on = bool(prefix_cache and chunked_prefill)
+    offload_on = bool(host_offload and chunked_prefill)
+    serve_cfg["int8_kv"] = int8_on
+    serve_cfg["prefix_cache"] = prefix_on
+    serve_cfg["host_offload"] = offload_on
+    if prefix_on and not on_tpu:
+        # Tiny-plane smoke sizing: prefixes shorter than the 64-token
+        # default so the prefix plane stays a sliver of the smoke pool.
+        serve_cfg.update(prefix_slots=4, prefix_len=16, min_prefix_len=4)
 
     model = GPT2LMHeadModel(cfg)
     rng = np.random.RandomState(0)
@@ -985,6 +1003,12 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
         name += "_nochunkedprefill"
     if not spec_decode:
         name += "_nospecdecode"
+    if not int8_kv:
+        name += "_noint8kv"
+    if not prefix_cache:
+        name += "_noprefixcache"
+    if not host_offload:
+        name += "_nohostoffload"
     return {
         "metric": name,
         "value": round(tok_per_sec, 1),
@@ -1013,6 +1037,15 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
             "chunked_prefill": chunked_prefill,
             "prefill_chunk": m["prefill_chunk"] if chunked_prefill else None,
             "spec_decode": spec_on,
+            "int8_kv": int8_on,
+            "prefix_cache": prefix_on,
+            "host_offload": offload_on,
+            "prefix_hit_rate": m.get("prefix_hit_rate"),
+            "kv_bytes_per_slot": m.get("kv_bytes_per_slot"),
+            "kv_bytes_aliased": m.get("kv_bytes_aliased"),
+            "effective_slots": m.get("effective_slots"),
+            "swap_outs": m.get("swap_outs"),
+            "swap_ins": m.get("swap_ins"),
             "spec_k": m.get("spec_k"),
             "spec_ngram": m.get("spec_ngram"),
             "accepted_per_step_mean": m.get("accepted_per_step_mean"),
@@ -1032,12 +1065,15 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
 
 
 def main_serve(smoke=False, flash_decode=None, chunked_prefill=True,
-               spec_decode=True):
+               spec_decode=True, int8_kv=True, prefix_cache=True,
+               host_offload=True):
     if not smoke:
         _require_tpu_or_exit()
     _emit(_measure_serving(smoke=smoke, flash_decode=flash_decode,
                            chunked_prefill=chunked_prefill,
-                           spec_decode=spec_decode))
+                           spec_decode=spec_decode, int8_kv=int8_kv,
+                           prefix_cache=prefix_cache,
+                           host_offload=host_offload))
     return 0
 
 
@@ -1075,25 +1111,34 @@ def _measure_sustained(smoke=False):
     if on_tpu:
         cfg = GPT2Config.gpt2_medium(dropout=0.0, use_flash_attention=True)
         serve_cfg = {"max_slots": 16, "max_len": 1024, "chunk_size": 16,
-                     "max_queue": 128}
+                     "max_queue": 128, "int8_kv": True,
+                     "prefix_cache": True, "host_offload": True}
+        # prefix_pool: a handful of shared system prompts with Zipf
+        # reuse — the traffic shape the shared-prefix cache exploits;
+        # its hit rate lands in the report via serve_cfg + metrics.
         base = dict(arrival="poisson", rate=12.0, n_requests=96,
                     prompt_dist="lognormal", prompt_mean=64,
                     prompt_max=256, output_dist="lognormal",
                     output_mean=96, output_min=8, output_max=256,
+                    prefix_pool=4, prefix_tokens=32,
                     vocab_size=cfg.vocab_size, seed=17)
         window_s, slo = 2.0, SLO(ttft_p99_ms=1500.0, itl_p99_ms=150.0)
         sweep_rates, sweep_n = (8.0, 12.0, 16.0, 24.0), 48
     else:
         cfg = GPT2Config.tiny(dropout=0.0, use_flash_attention=False)
         serve_cfg = {"max_slots": 4, "max_len": 64, "chunk_size": 4,
-                     "max_queue": 64}
+                     "max_queue": 64, "int8_kv": True,
+                     "prefix_cache": True, "host_offload": True,
+                     "prefix_slots": 4, "prefix_len": 16,
+                     "min_prefix_len": 4}
         # Dense enough that every window carries completions (the
         # acceptance bar: >= 3 windows with real percentiles), short
         # enough for tier-1.
         base = dict(arrival="poisson", rate=60.0, n_requests=48,
                     prompt_dist="lognormal", prompt_mean=8, prompt_max=16,
                     output_dist="lognormal", output_mean=6, output_min=2,
-                    output_max=12, vocab_size=cfg.vocab_size, seed=17)
+                    output_max=12, prefix_pool=2, prefix_tokens=8,
+                    vocab_size=cfg.vocab_size, seed=17)
         window_s = 0.1
         # Schema-exercise budgets: wide enough that CPU jitter never
         # nulls the sweep, tight enough that a wedged engine still fails.
@@ -1507,9 +1552,16 @@ def _dispatch(argv):
     # --no-spec-decode: the draft-free side of the speculative-decoding
     # A/B (default True — n-gram drafting on; metric suffixed
     # _nospecdecode so the series never mix).
+    # --no-int8-kv / --no-prefix-cache / --no-host-offload: the
+    # hierarchy-off sides of the KV-memory-hierarchy A/Bs (default True
+    # each; metric suffixed _noint8kv / _noprefixcache / _nohostoffload
+    # so the series never mix).
     flash_decode = False if "--no-flash-decode" in argv else None
     chunked = "--no-chunked-prefill" not in argv
     spec = "--no-spec-decode" not in argv
+    int8_kv = "--no-int8-kv" not in argv
+    prefix_cache = "--no-prefix-cache" not in argv
+    host_offload = "--no-host-offload" not in argv
     if "--fleet-smoke" in argv:
         return main_fleet(smoke=True)
     if "--fleet" in argv:
@@ -1522,10 +1574,14 @@ def _dispatch(argv):
         return main_sustained(smoke="--smoke" in argv)
     if "--serve-smoke" in argv:
         return main_serve(smoke=True, flash_decode=flash_decode,
-                          chunked_prefill=chunked, spec_decode=spec)
+                          chunked_prefill=chunked, spec_decode=spec,
+                          int8_kv=int8_kv, prefix_cache=prefix_cache,
+                          host_offload=host_offload)
     if "--serve" in argv:
         return main_serve(flash_decode=flash_decode,
-                          chunked_prefill=chunked, spec_decode=spec)
+                          chunked_prefill=chunked, spec_decode=spec,
+                          int8_kv=int8_kv, prefix_cache=prefix_cache,
+                          host_offload=host_offload)
     if "--sweep" in argv:
         return main_sweep()
     if "--xl-compute" in argv:

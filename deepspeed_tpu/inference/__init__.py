@@ -19,6 +19,10 @@ from deepspeed_tpu.inference.fleet import (  # noqa: F401
     FleetRequest,
     ServingFleet,
 )
+from deepspeed_tpu.inference.kv_hierarchy import (  # noqa: F401
+    HierarchySpec,
+    KVHierarchy,
+)
 from deepspeed_tpu.inference.kv_pool import init_pool, kv_spec  # noqa: F401
 from deepspeed_tpu.inference.resilience import (  # noqa: F401
     HEALTH_STATES,

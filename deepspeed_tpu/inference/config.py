@@ -40,6 +40,14 @@ INFERENCE_DEFAULTS = {
     "recovery_max_retries": 2,
     "recovery_backoff_s": 0.0,
     "replica_id": None,
+    "int8_kv": False,
+    "prefix_cache": False,
+    "prefix_slots": 8,
+    "prefix_len": 64,
+    "min_prefix_len": 8,
+    "host_offload": False,
+    "swap_slots": 8,
+    "hbm_budget_bytes": None,
 }
 
 
@@ -154,6 +162,42 @@ class InferenceConfig:
     # signal a router consumes is attributable. None for a standalone
     # engine — no labels, identical output to pre-fleet builds.
     replica_id: Optional[int] = None
+    # --- KV memory hierarchy (inference/kv_hierarchy/) ------------------
+    # Store the KV pool as int8 codes with fp32 per-(head, position)
+    # scales; the flash-decode kernel dequantizes in-block (the
+    # "decode_attention_q8" family) and the einsum path dequantizes
+    # before attending. Roughly quarters the plane bytes per slot at the
+    # cost of <= scale/2 per-element reconstruction error.
+    int8_kv: bool = False
+    # Shared-prefix cache: a host-side radix trie over prompt token ids
+    # detects shared prefixes at admission and aliases the matched span
+    # onto a read-only prefix plane — the slot's private plane only holds
+    # the suffix, and prefill skips the aliased span entirely (the TTFT
+    # win). Requires chunked_prefill (the aliasing rides the mixed-step
+    # program's cache view).
+    prefix_cache: bool = False
+    # Read-only prefix plane rows (compile-shape: the gather dimension of
+    # the prefix store). Refcounted; LRU-evicted when full.
+    prefix_slots: int = 8
+    # Max positions a prefix row holds — longer shared spans alias only
+    # their first prefix_len positions.
+    prefix_len: int = 64
+    # Shortest shared span worth aliasing: matches below this prefill
+    # normally (trie bookkeeping overhead would exceed the saving).
+    min_prefix_len: int = 8
+    # Host offload: swap an idle session's KV slot (planes + scalars) to
+    # host RAM via fixed-shape transfers and restore on resume, driven by
+    # the scheduler's ``swapped`` phase. Requires chunked_prefill.
+    host_offload: bool = False
+    # Max concurrently swapped-out sessions (bounds host RAM at
+    # swap_slots * bytes-per-slot).
+    swap_slots: int = 8
+    # Simulated HBM budget for the effective_slots capacity gauge
+    # (telemetry): how many slots WOULD fit in this many bytes under the
+    # current hierarchy config. None: use the flat-fp pool's own
+    # footprint as the budget, making the gauge a direct "x more slots
+    # at the bytes we used to spend" ratio.
+    hbm_budget_bytes: Optional[int] = None
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -196,6 +240,36 @@ class InferenceConfig:
                 "inference.spec_decode=True requires chunked_prefill: "
                 "speculation is fused into the mixed-step program's decode "
                 "lane (the legacy bucket path has no speculation lane)")
+        if self.prefix_cache and not self.chunked_prefill:
+            raise ValueError(
+                "inference.prefix_cache=True requires chunked_prefill: "
+                "prefix aliasing rides the mixed-step program's cache view "
+                "(the legacy bucket path prefills whole prompts)")
+        if self.host_offload and not self.chunked_prefill:
+            raise ValueError(
+                "inference.host_offload=True requires chunked_prefill: "
+                "swap decisions happen at the mixed-step admission boundary")
+        if self.prefix_slots < 1:
+            raise ValueError("inference.prefix_slots must be >= 1, got "
+                             "{}".format(self.prefix_slots))
+        if self.min_prefix_len < 1:
+            raise ValueError("inference.min_prefix_len must be >= 1, got "
+                             "{}".format(self.min_prefix_len))
+        if self.prefix_len < self.min_prefix_len:
+            raise ValueError(
+                "inference.prefix_len={} must be >= min_prefix_len={}"
+                .format(self.prefix_len, self.min_prefix_len))
+        if self.prefix_len > self.max_len:
+            raise ValueError(
+                "inference.prefix_len={} exceeds max_len={}".format(
+                    self.prefix_len, self.max_len))
+        if self.swap_slots < 1:
+            raise ValueError("inference.swap_slots must be >= 1, got "
+                             "{}".format(self.swap_slots))
+        if self.hbm_budget_bytes is not None and self.hbm_budget_bytes <= 0:
+            raise ValueError(
+                "inference.hbm_budget_bytes must be > 0 (or None for the "
+                "flat-pool baseline), got {}".format(self.hbm_budget_bytes))
         buckets = self.prefill_buckets
         if buckets is None:
             buckets = default_buckets(self.max_len)

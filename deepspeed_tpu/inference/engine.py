@@ -87,16 +87,26 @@ from deepspeed_tpu.inference.resilience import (
     StepWatchdog,
     fatal_step_errors,
 )
+from deepspeed_tpu.inference.kv_hierarchy import (
+    KVHierarchy,
+    capture_slot,
+    restore_slot,
+    spec_from_config,
+)
 from deepspeed_tpu.inference.kv_pool import (
     cache_view,
+    fold_cache,
     harvest_snapshot,
     init_pool,
     max_active_frontier,
+    plane_len_for,
     pool_nbytes,
     pool_shardings,
     shard_pool,
+    slot_cache_view,
+    write_slot_cache,
 )
-from deepspeed_tpu.inference.scheduler import Scheduler
+from deepspeed_tpu.inference.scheduler import QueueFull, Scheduler
 from deepspeed_tpu.models import generation
 from deepspeed_tpu.parallel import mesh as mesh_lib
 from deepspeed_tpu.telemetry import (
@@ -209,18 +219,12 @@ def _prefill_program(params, gcfg, pool, prompt, prompt_len, slot,
     pass. ``prompt`` is [1, bucket] (padded right; pad ids are arbitrary
     — their logits are never read and their k/v writes sit beyond the
     frontier). Returns (pool', first_token)."""
-    ks = jax.lax.dynamic_slice_in_dim(pool["k"], slot, 1, axis=1)
-    vs = jax.lax.dynamic_slice_in_dim(pool["v"], slot, 1, axis=1)
-    cache = {"k": ks, "v": vs, "pos": jnp.zeros((1,), jnp.int32)}
+    cache = slot_cache_view(pool, slot, jnp.zeros((1,), jnp.int32))
     logits, cache = generation._forward(params, gcfg, prompt, cache)
     last = logits[0, prompt_len - 1]                    # true last row [V]
     first = _sample_rows(last[None], temp[None], top_k[None], seed[None],
                          prompt_len[None])[0]
-    pool = dict(pool)
-    pool["k"] = jax.lax.dynamic_update_slice_in_dim(
-        pool["k"], cache["k"], slot, axis=1)
-    pool["v"] = jax.lax.dynamic_update_slice_in_dim(
-        pool["v"], cache["v"], slot, axis=1)
+    pool = write_slot_cache(pool, slot, cache)
     # The first token counts against the budget; a request can finish at
     # admission (max_new==1, or its first token IS its EOS).
     finished = (max_new <= 1) | ((eos_id >= 0) & (first == eos_id))
@@ -252,7 +256,7 @@ def _decode_chunk_program(params, gcfg, chunk, pool):
         hit_eos = (pool["eos"] >= 0) & (nxt == pool["eos"])
         remaining = jnp.where(was_active, pool["remaining"] - 1,
                               pool["remaining"])
-        pool = dict(pool, k=cache["k"], v=cache["v"],
+        pool = dict(fold_cache(pool, cache),
                     pos=jnp.where(was_active, cache["pos"], old_pos),
                     last_tok=nxt,
                     active=was_active & ~hit_eos & (remaining > 0),
@@ -326,7 +330,7 @@ def _spec_decode_chunk_program(params, gcfg, chunk, spec_k, spec_ngram,
         # a later write covers before the drafter can match them.
         ring = jax.vmap(lambda r, c, p: jax.lax.dynamic_update_slice(
             r, c, (p + 1,)))(pool["toks"], choices, old_pos)
-        pool = dict(pool, k=cache["k"], v=cache["v"], toks=ring,
+        pool = dict(fold_cache(pool, cache), toks=ring,
                     pos=jnp.where(was_active, old_pos + n_acc, old_pos),
                     last_tok=jnp.where(was_active, last, pool["last_tok"]),
                     active=was_active & ~hit_eos & (remaining > 0),
@@ -373,9 +377,11 @@ def _mixed_step_program(params, gcfg, chunk, spec, pool, p_ids, p_slot,
     C = p_ids.shape[1]
 
     def _lane(pool):
-        ks = jax.lax.dynamic_slice_in_dim(pool["k"], p_slot, 1, axis=1)
-        vs = jax.lax.dynamic_slice_in_dim(pool["v"], p_slot, 1, axis=1)
-        cache = {"k": ks, "v": vs, "pos": p_frontier[None]}
+        # slot_cache_view carries the hierarchy along: scale-plane
+        # slices when quantizing, and the slot's aliased prefix row —
+        # an attached request's first chunk starts AT pbase, attending
+        # the shared plane below it.
+        cache = slot_cache_view(pool, p_slot, p_frontier[None])
         logits, cache = generation.append_forward(
             params, gcfg, p_ids, cache, n_valid=p_valid[None])
         # The prompt's true last row (garbage pad rows sit past it).
@@ -383,11 +389,7 @@ def _mixed_step_program(params, gcfg, chunk, spec, pool, p_ids, p_slot,
             logits[0], jnp.clip(p_valid - 1, 0, C - 1), keepdims=False)
         first = _sample_rows(last[None], p_temp[None], p_top_k[None],
                              p_seed[None], (p_frontier + p_valid)[None])[0]
-        pool = dict(pool)
-        pool["k"] = jax.lax.dynamic_update_slice_in_dim(
-            pool["k"], cache["k"], p_slot, axis=1)
-        pool["v"] = jax.lax.dynamic_update_slice_in_dim(
-            pool["v"], cache["v"], p_slot, axis=1)
+        pool = write_slot_cache(pool, p_slot, cache)
         # Mid-prefill slices only move the frontier; the final slice
         # installs the full decode state (same fields as the legacy
         # prefill). First token counts against the budget; a request can
@@ -480,6 +482,18 @@ class InferenceEngine(object):
         if self._spec is not None:
             slack = max(slack, config.spec_k + 1)
         self._slack = slack
+        # KV memory hierarchy (inference/kv_hierarchy): None when every
+        # tier is off — the flat pool, bit-for-bit the pre-hierarchy
+        # engine. The spec is part of the pool-shape contract, so it
+        # must exist before _build_pool.
+        hspec = spec_from_config(config)
+        self._hier = None
+        self._last_swap_out_s = None
+        if hspec.enabled:
+            self._hier = KVHierarchy(
+                hspec, self._gcfg,
+                plane_len_for(self._gcfg, config.max_len, slack),
+                config.max_slots, config.hbm_budget_bytes)
         self._tp = mesh is not None and mesh_lib.mp_size(mesh) > 1
         pool = self._build_pool()
         if self._tp:
@@ -532,7 +546,15 @@ class InferenceEngine(object):
             # and faults_injected are get-or-create by name, so the
             # scheduler's and injector's handles are these same objects.
             "faults_injected", "recoveries", "requests_replayed",
-            "deadline_sheds", "step_stalls"))
+            "deadline_sheds", "step_stalls",
+            # KV-hierarchy counters (docs/OBSERVABILITY.md) — zero
+            # forever on a flat-pool engine.
+            "prefix_hits", "prefix_misses", "prefix_inserts",
+            "prefix_evictions", "swap_outs", "swap_ins"))
+        if self._hier is not None:
+            # The hierarchy increments hits/misses/inserts itself; hand
+            # it the bank so those land in the same registry counters.
+            self._hier.counters = self.counters
         # Resilience: health machine (exports the ``health_state`` live
         # gauge), step watchdog, recovery bookkeeping. The fault
         # injector stays None unless inject_faults() arms one — every
@@ -558,6 +580,21 @@ class InferenceEngine(object):
             self._scheduler.occupancy)
         self.telemetry.gauge("kv_pool_bytes").set_fn(
             lambda: pool_nbytes(self._pool))
+        if self._hier is not None:
+            h = self._hier
+            self.telemetry.gauge("prefix_hit_rate").set_fn(h.hit_rate)
+            self.telemetry.gauge("kv_bytes_aliased").set_fn(
+                h.bytes_aliased_live)
+            self.telemetry.gauge("kv_bytes_per_slot").set_fn(
+                h.bytes_per_slot)
+            self.telemetry.gauge("effective_slots").set_fn(
+                h.effective_slots)
+            self.telemetry.gauge("slots_swapped").set_fn(
+                lambda: len(self._scheduler.swapped))
+            self._swap_out_hist = self.telemetry.histogram(
+                "swap_out_seconds")
+            self._swap_in_hist = self.telemetry.histogram(
+                "swap_in_seconds")
         # Latency histograms (queue_wait_seconds lives in the scheduler;
         # same registry object — get-or-create is by name).
         self._ttft_hist = self.telemetry.histogram("ttft_seconds")
@@ -591,7 +628,8 @@ class InferenceEngine(object):
         jit cache serves it untouched: recovery never recompiles
         (the recovery invariant's compile_count clause)."""
         pool = init_pool(self._gcfg, self.config.max_slots,
-                         self.config.max_len, slack=self._slack)
+                         self.config.max_len, slack=self._slack,
+                         hier=self._hier.spec if self._hier else None)
         if self._tp:
             pool = shard_pool(self.mesh, pool, self._gcfg.n_head)
         return pool
@@ -697,6 +735,12 @@ class InferenceEngine(object):
             time.sleep(self.config.recovery_backoff_s *
                        self._recovery_streak)
         self._pool = self._build_pool()
+        if self._hier is not None:
+            # The trie/refcounts/swap records all described the pool
+            # that just died (requeue_running pulls SWAPPED sessions
+            # back into the queue too). Drop them; replay re-earns
+            # every hit and re-inserts every prefix.
+            self._hier.reset()
         replayed = self._scheduler.requeue_running()
         self._replay_requests(replayed)
         self.counters["recoveries"] += 1
@@ -774,12 +818,44 @@ class InferenceEngine(object):
                 raise ValueError("deadline_ms must be > 0, got "
                                  "{}".format(deadline_ms))
             deadline = time.time() + deadline_ms / 1e3
-        return self._scheduler.submit(
-            prompt, int(max_new_tokens), float(temperature),
-            int(top_k or 0), -1 if eos_token_id is None else int(eos_token_id),
-            int(seed),
-            spec=self._spec is not None and spec_decode is not False,
-            deadline=deadline)
+        try:
+            return self._scheduler.submit(
+                prompt, int(max_new_tokens), float(temperature),
+                int(top_k or 0),
+                -1 if eos_token_id is None else int(eos_token_id),
+                int(seed),
+                spec=self._spec is not None and spec_decode is not False,
+                deadline=deadline)
+        except QueueFull as exc:
+            raise self._augment_queue_full(exc) from None
+
+    def _augment_queue_full(self, exc):
+        """Backpressure triage for the KV hierarchy: when the engine is
+        full but host offload could free a slot (an idle decoding
+        session exists and the swap store has room), mark the shed
+        ``swap_eligible`` and ARM the swap — the next step evicts a
+        victim, so the caller should retry here rather than fail over.
+        With a swap already in flight, ``retry_after_s`` becomes the
+        expected swap-out latency (last observed; a conservative default
+        before any swap has been timed) instead of the completions-rate
+        guess — capacity appears on swap cadence, not completion
+        cadence."""
+        hier = self._hier
+        if hier is None or not hier.spec.offload:
+            return exc
+        victims = any(r.phase == "decoding"
+                      for r in self._scheduler.running.values())
+        if not victims or not hier.swap_capacity_left():
+            return exc
+        in_flight = hier.swap_requested or bool(self._scheduler.swapped)
+        hier.swap_requested = True
+        exc.swap_eligible = True
+        if in_flight:
+            exc.retry_after_s = self._expected_swap_out_s()
+        return exc
+
+    def _expected_swap_out_s(self):
+        return self._last_swap_out_s if self._last_swap_out_s else 0.05
 
     # ------------------------------------------------------------- cancel
 
@@ -792,6 +868,10 @@ class InferenceEngine(object):
         slot = req.slot
         if not self._scheduler.cancel(req):
             return False
+        if self._hier is not None:
+            # Unpin any prefix row and drop a swapped session's host
+            # record (a swapped cancel has no slot to deactivate).
+            self._hier.on_release(req)
         if was_decoding:
             # Freeze the slot on device so the decode lane stops burning
             # its rows (a prefilling slot was never active — nothing to
@@ -841,6 +921,8 @@ class InferenceEngine(object):
         first) / (tokens - 1)) is one observation — the same statistic
         _latency_percentiles always reported, now windowed."""
         self._scheduler.complete(req.slot)
+        if self._hier is not None:
+            self._hier.on_release(req)
         self.counters["requests_completed"] += 1
         if req.first_token_time is not None and len(req.tokens) > 1:
             self._itl_hist.observe(
@@ -901,9 +983,89 @@ class InferenceEngine(object):
                 inj.advance()
         return done
 
+    def _admit(self):
+        """One admission round, with the hierarchy's admission hook per
+        admitted pair (prefix-trie probe; stamps pid/pbase and advances
+        the cursor past an aliased span)."""
+        pairs = self._scheduler.admissions()
+        if self._hier is not None:
+            for req, slot in pairs:
+                self._pool = self._hier.on_admit(self._pool, req, slot)
+        return pairs
+
+    def _swap_in_ready(self):
+        """RESUME-FIRST: pour free slots into the oldest swapped
+        sessions before fresh admissions see them. Eager restores —
+        unwatched by the recompile detector, zero compiles. Returns the
+        resumed rids (this round's swap-out exclusion set)."""
+        resumed = []
+        while True:
+            req = self._scheduler.next_swap_in()
+            if req is None:
+                break
+            free = self._scheduler.free_slot_ids()
+            if not free:
+                break
+            t0 = time.time()
+            slot = free[0]
+            record = self._hier.swap_store.pop(req.rid)
+            self._pool = restore_slot(self._pool, slot, record)
+            self._scheduler.swap_in(req, slot)
+            self.counters["swap_ins"] += 1
+            self._swap_in_hist.observe(time.time() - t0)
+            resumed.append(req.rid)
+        return resumed
+
+    def _pick_swap_victim(self, exclude):
+        """The decoding session that can best afford to wait: largest
+        remaining budget (most decode steps left to amortize the swap),
+        oldest rid on ties. Sessions resumed THIS round are excluded —
+        no same-step thrash."""
+        cands = [r for r in self._scheduler.running.values()
+                 if r.phase == "decoding" and r.rid not in exclude]
+        if not cands:
+            return None
+        return max(cands,
+                   key=lambda r: (r.max_new_tokens - len(r.tokens), -r.rid))
+
+    def _maybe_swap_out(self, resumed):
+        """Swap-out policy: under slot pressure (queued work, no free
+        slot) or an armed submit-side request, capture ONE victim to
+        host RAM, free its slot, and re-run admissions so the queue head
+        lands in it THIS step. One swap per step bounds the eager
+        transfer cost a step can absorb."""
+        hier = self._hier
+        pressure = bool(self._scheduler.queue) \
+            and not self._scheduler.free_slot_ids()
+        if not (pressure or hier.swap_requested):
+            return
+        hier.swap_requested = False
+        if not hier.swap_capacity_left():
+            return
+        victim = self._pick_swap_victim(set(resumed))
+        if victim is None:
+            return
+        t0 = time.time()
+        # Capture BEFORE deactivating: the record must restore
+        # active=True so the resumed slot decodes again.
+        record = capture_slot(self._pool, victim.slot)
+        hier.swap_store.put(victim.rid, record)
+        self._pool = dict(self._pool, active=self._pool["active"]
+                          .at[victim.slot].set(False))
+        self._scheduler.swap_out(victim)
+        self.counters["swap_outs"] += 1
+        self._last_swap_out_s = time.time() - t0
+        self._swap_out_hist.observe(self._last_swap_out_s)
+        if self._scheduler.queue:
+            self._admit()
+
     def _step_chunked(self):
         done = []
-        self._scheduler.admissions()
+        offload = self._hier is not None and self._hier.spec.offload
+        resumed = self._swap_in_ready() if offload else []
+        self._admit()
+        if offload:
+            self._maybe_swap_out(resumed)
         pf = self._scheduler.next_prefill()
         C = self.config.prefill_chunk
         ids = np.zeros((1, C), np.int32)
@@ -982,6 +1144,11 @@ class InferenceEngine(object):
             self.counters["prefill_tokens"] += n_valid
             if self._scheduler.advance_prefill(pf, n_valid):
                 self.counters["prefills"] += 1
+                if self._hier is not None:
+                    # The slot's plane now holds the full prompt's k/v —
+                    # publish a missed prefix into the shared store
+                    # (eager copy; no compile).
+                    self._pool = self._hier.on_prefill_done(self._pool, pf)
                 self._harvest_first(pf, int(first), done)
 
         for slot, req in list(self._scheduler.running.items()):
@@ -1226,6 +1393,31 @@ class InferenceEngine(object):
                 "draft_accept_rate": (
                     round(float((acc - 1).sum()) / (self.config.spec_k * n),
                           4) if n else None),
+            })
+        if self._hier is not None:
+            h = self._hier
+            m.update({
+                # Tier switches (stamped into bench results for A/B
+                # attribution) + the capacity story: what a slot costs,
+                # what aliasing saves, and how many sessions the budget
+                # effectively carries (docs/INFERENCE.md).
+                "int8_kv": h.spec.int8,
+                "prefix_cache": h.spec.prefix,
+                "host_offload": h.spec.offload,
+                "prefix_hits": c.window("prefix_hits"),
+                "prefix_misses": c.window("prefix_misses"),
+                "prefix_inserts": c.window("prefix_inserts"),
+                "prefix_evictions": c.window("prefix_evictions"),
+                "prefix_hit_rate": round(h.hit_rate(), 4),
+                "kv_bytes_per_slot": h.bytes_per_slot(),
+                "kv_bytes_per_slot_flat": h.flat_bytes_per_slot(),
+                "kv_bytes_aliased": h.bytes_aliased_live(),
+                "prefix_bytes_aliased_total": h.bytes_aliased_total(),
+                "prefix_store_bytes": h.prefix_store_bytes(),
+                "effective_slots": h.effective_slots(),
+                "swap_outs": c.window("swap_outs"),
+                "swap_ins": c.window("swap_ins"),
+                "slots_swapped": len(self._scheduler.swapped),
             })
         m.update(self._latency_percentiles())
         if reset:

@@ -1,0 +1,51 @@
+"""deepspeed_tpu.inference.kv_hierarchy — three-tier KV memory.
+
+The flat slot pool (inference/kv_pool.py) hard-caps concurrent users per
+chip at HBM divided by one fp plane per slot. This package layers three
+multiplicative capacity wins behind the SAME slot-pool contract — zero
+recompiles after warmup, greedy bit-identical, crash-only recovery
+intact:
+
+- **Shared-prefix cache** (prefix_cache.py): a host-side radix trie over
+  prompt token ids detects shared prefixes at admission; slots alias a
+  read-only prefix plane and prefill starts past the aliased span. The
+  aliasing is a per-position SELECT against the slot's own plane — the
+  effective plane is elementwise equal to what the slot's own prefill
+  would have written, so greedy streams stay bit-identical.
+- **int8 KV** (quant.py, kernel in ops/transformer/kernels/
+  decode_attention.py): planes store int8 codes with fp32
+  per-(head, position) scales; the flash-decode kernel dequantizes
+  in-block ("decode_attention_q8" autotuner family), the einsum path
+  before attending. ~4x fewer plane bytes per slot.
+- **Host offload** (offload.py): idle-session slots swap to host RAM as
+  fixed-shape captures (planes + every per-slot scalar) and restore on
+  resume — the serving analogue of ZeRO-Offload's cpu_offload, driven by
+  the scheduler's ``swapped`` phase. All transfers are EAGER device
+  ops, so the watched jitted programs never recompile.
+
+``hierarchy.py`` ties them together: ``HierarchySpec`` (the pool-shape
+contract ``init_pool`` consumes), ``spec_from_config``, and the
+``KVHierarchy`` facade the engine drives (on_admit / on_prefill_done /
+on_release / reset, swap store, byte accounting). Everything host-side
+here is DERIVED state: ``reset()`` drops it all and the request records
+rebuild behavior bit-identically (docs/RESILIENCE.md).
+"""
+
+from deepspeed_tpu.inference.kv_hierarchy.hierarchy import (  # noqa: F401
+    HierarchySpec,
+    KVHierarchy,
+    spec_from_config,
+)
+from deepspeed_tpu.inference.kv_hierarchy.offload import (  # noqa: F401
+    HostSwapStore,
+    capture_slot,
+    restore_slot,
+)
+from deepspeed_tpu.inference.kv_hierarchy.prefix_cache import (  # noqa: F401
+    PrefixStore,
+    RadixTrie,
+)
+from deepspeed_tpu.inference.kv_hierarchy.quant import (  # noqa: F401
+    dequantize_kv,
+    quantize_kv,
+)

@@ -1,0 +1,82 @@
+"""Host offload: fixed-shape slot capture/restore + the host swap store.
+
+A swap-out captures ONE slot's entire device footprint — KV plane slices,
+int8 scale slices when present, the token ring row, every per-slot
+scalar (including the prefix attachment fields), with ``active`` captured
+*before* the engine deactivates the slot so restore reactivates it — in a
+single batched ``jax.device_get``. Every captured array has a shape fixed
+by the pool config, independent of which slot or how far into its stream
+the session is: the transfer buffers never change shape, so nothing here
+can perturb the compiled programs (capture/restore are eager ops, which
+the recompile detector does not watch).
+
+Restore writes the record back with eager ``.at[...].set`` into whatever
+slot the scheduler hands out — the slot index need not match the one
+captured, because every positional fact (pos, toks ring, prefix base)
+travels inside the record. The restored plane is bit-identical to the
+captured one, so the resumed greedy stream continues exactly where it
+paused.
+"""
+
+import jax
+import jax.numpy as jnp
+
+# Plane-like pool entries sliced along the slot axis (axis 1).
+_PLANE_KEYS = ("k", "v", "k_scale", "v_scale")
+
+
+def capture_slot(pool, slot):
+    """Snapshot slot ``slot`` to host memory; returns {name: np.ndarray}."""
+    slot = int(slot)
+    arrs = {}
+    for name, arr in pool.items():
+        if name in ("pk", "pv", "pk_scale", "pv_scale"):
+            continue  # shared prefix planes stay resident
+        if name in _PLANE_KEYS:
+            arrs[name] = arr[:, slot]
+        else:
+            arrs[name] = arr[slot]
+    return jax.device_get(arrs)
+
+
+def restore_slot(pool, slot, record):
+    """Write a captured record into slot ``slot``; returns the new pool."""
+    slot = int(slot)
+    pool = dict(pool)
+    for name, val in record.items():
+        val = jnp.asarray(val, pool[name].dtype)
+        if name in _PLANE_KEYS:
+            pool[name] = pool[name].at[:, slot].set(val)
+        else:
+            pool[name] = pool[name].at[slot].set(val)
+    return pool
+
+
+class HostSwapStore:
+    """rid -> captured record, bounded by the configured swap slots."""
+
+    def __init__(self, capacity):
+        self.capacity = int(capacity)
+        self.records = {}
+
+    def capacity_left(self):
+        return len(self.records) < self.capacity
+
+    def put(self, rid, record):
+        if not self.capacity_left():
+            raise RuntimeError("host swap store full "
+                               "({} records)".format(self.capacity))
+        self.records[rid] = record
+
+    def pop(self, rid):
+        return self.records.pop(rid, None)
+
+    def __len__(self):
+        return len(self.records)
+
+    def nbytes(self):
+        return sum(v.nbytes for rec in self.records.values()
+                   for v in rec.values())
+
+    def clear(self):
+        self.records.clear()

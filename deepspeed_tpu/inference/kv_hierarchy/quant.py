@@ -1,0 +1,13 @@
+"""int8 KV quantization — re-exported from the decode-attention kernel
+module, which is where the math must live: ``models/generation.py``
+already imports that module, and an import in the other direction
+(kernel -> inference package) would be circular. Symmetric per-(head,
+position) absmax scaling; see ``quantize_kv``/``dequantize_kv`` there
+for the exact contract and the parity tests in
+tests/unit/test_decode_attention.py for the error bound.
+"""
+
+from deepspeed_tpu.ops.transformer.kernels.decode_attention import (  # noqa: F401,E501
+    dequantize_kv,
+    quantize_kv,
+)

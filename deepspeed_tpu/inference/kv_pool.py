@@ -121,21 +121,52 @@ def plane_len_for(gcfg, max_len, slack=0):
     return max_len + slack
 
 
-def init_pool(gcfg, num_slots, max_len, dtype=None, slack=0):
+def init_pool(gcfg, num_slots, max_len, dtype=None, slack=0, hier=None):
     """Zeroed pool pytree for ``num_slots`` sequences of up to ``max_len``
     positions under generation config ``gcfg`` (models.generation.as_gencfg).
-    The allocated plane length is ``plane_len_for(gcfg, max_len, slack)``."""
+    The allocated plane length is ``plane_len_for(gcfg, max_len, slack)``.
+
+    ``hier`` (a kv_hierarchy.HierarchySpec, or None for the flat pool)
+    widens the pool shape contract:
+
+    - ``hier.int8``: the k/v planes hold int8 codes and the pool gains
+      fp32 ``k_scale``/``v_scale`` [L, S, H, plane_len] — one symmetric
+      absmax scale per (head, position), written by the same frontier
+      writes as the codes and obeying the same stale rule;
+    - ``hier.prefix``: read-only shared planes ``pk``/``pv``
+      [L, prefix_slots, H, prefix_len, D] (+ scales when int8) plus
+      per-slot ``pid`` (aliased row, -1 detached) and ``pbase`` (aliased
+      span; positions < pbase resolve to the prefix row). pbase==0 makes
+      a stale pid inert, so -1 needs no special casing in the programs.
+    """
     dtype = dtype or gcfg.dtype
     hd = gcfg.n_embd // gcfg.n_head
     plane_len = plane_len_for(gcfg, max_len, slack)
     if getattr(gcfg, "use_flash_decode", False):
         assert decode_attention.decode_supported(plane_len), plane_len
+    int8 = hier is not None and hier.int8
+    kv_dtype = jnp.int8 if int8 else dtype
     kv_shape = (gcfg.n_layer, num_slots, gcfg.n_head, plane_len, hd)
-    pool = {"k": jnp.zeros(kv_shape, dtype), "v": jnp.zeros(kv_shape, dtype),
+    pool = {"k": jnp.zeros(kv_shape, kv_dtype),
+            "v": jnp.zeros(kv_shape, kv_dtype),
             # Token ring for n-gram self-drafting (module docstring) —
             # same length as the planes so ring writes share the slack
             # bound; int32 [slots, plane_len] is noise next to the k/v.
             "toks": jnp.zeros((num_slots, plane_len), jnp.int32)}
+    if int8:
+        sc_shape = kv_shape[:-1]
+        pool["k_scale"] = jnp.zeros(sc_shape, jnp.float32)
+        pool["v_scale"] = jnp.zeros(sc_shape, jnp.float32)
+    if hier is not None and hier.prefix:
+        p_shape = (gcfg.n_layer, hier.prefix_slots, gcfg.n_head,
+                   hier.prefix_len, hd)
+        pool["pk"] = jnp.zeros(p_shape, kv_dtype)
+        pool["pv"] = jnp.zeros(p_shape, kv_dtype)
+        if int8:
+            pool["pk_scale"] = jnp.zeros(p_shape[:-1], jnp.float32)
+            pool["pv_scale"] = jnp.zeros(p_shape[:-1], jnp.float32)
+        pool["pid"] = jnp.full((num_slots,), -1, jnp.int32)
+        pool["pbase"] = jnp.zeros((num_slots,), jnp.int32)
     for name, ft, fill in _SLOT_FIELDS:
         pool[name] = jnp.full((num_slots,), fill, ft)
     return pool
@@ -181,8 +212,79 @@ def pool_nbytes(pool):
 
 def cache_view(pool):
     """The pool's k/v/pos as a ``models.generation`` cache dict — the
-    decode step program consumes the pool's slots directly as batch rows."""
-    return {"k": pool["k"], "v": pool["v"], "pos": pool["pos"]}
+    decode step program consumes the pool's slots directly as batch rows.
+
+    Hierarchy fields ride along data-driven (``_forward`` dispatches on
+    the keys present, so the flat pool costs nothing new): int8 scale
+    planes pass through, and each slot's aliased prefix row is GATHERED
+    to a per-slot ``pk``/``pv`` [L, S, H, prefix_len, D] view — the
+    clip makes a detached pid (-1) gather row 0 harmlessly, because its
+    pbase of 0 selects none of it."""
+    cache = {"k": pool["k"], "v": pool["v"], "pos": pool["pos"]}
+    if "k_scale" in pool:
+        cache["k_scale"] = pool["k_scale"]
+        cache["v_scale"] = pool["v_scale"]
+    if "pid" in pool:
+        row = jnp.clip(pool["pid"], 0, pool["pk"].shape[1] - 1)
+        cache["pk"] = jnp.take(pool["pk"], row, axis=1)
+        cache["pv"] = jnp.take(pool["pv"], row, axis=1)
+        cache["pbase"] = pool["pbase"]
+        if "pk_scale" in pool:
+            cache["pk_scale"] = jnp.take(pool["pk_scale"], row, axis=1)
+            cache["pv_scale"] = jnp.take(pool["pv_scale"], row, axis=1)
+    return cache
+
+
+def slot_cache_view(pool, slot, pos):
+    """ONE slot's k/v as a batch-1 cache dict for the prefill lane:
+    plane slices (and scale slices when int8) along the slot axis, plus
+    the slot's gathered prefix row when the pool carries one. ``slot``
+    may be traced; ``pos`` is the [1]-shaped append frontier."""
+    cache = {"k": jax.lax.dynamic_slice_in_dim(pool["k"], slot, 1, axis=1),
+             "v": jax.lax.dynamic_slice_in_dim(pool["v"], slot, 1, axis=1),
+             "pos": pos}
+    if "k_scale" in pool:
+        cache["k_scale"] = jax.lax.dynamic_slice_in_dim(
+            pool["k_scale"], slot, 1, axis=1)
+        cache["v_scale"] = jax.lax.dynamic_slice_in_dim(
+            pool["v_scale"], slot, 1, axis=1)
+    if "pid" in pool:
+        row = jnp.clip(jax.lax.dynamic_index_in_dim(
+            pool["pid"], slot, keepdims=False), 0, pool["pk"].shape[1] - 1)
+        cache["pk"] = jax.lax.dynamic_slice_in_dim(pool["pk"], row, 1, axis=1)
+        cache["pv"] = jax.lax.dynamic_slice_in_dim(pool["pv"], row, 1, axis=1)
+        cache["pbase"] = jax.lax.dynamic_index_in_dim(
+            pool["pbase"], slot, keepdims=False)[None]
+        if "pk_scale" in pool:
+            cache["pk_scale"] = jax.lax.dynamic_slice_in_dim(
+                pool["pk_scale"], row, 1, axis=1)
+            cache["pv_scale"] = jax.lax.dynamic_slice_in_dim(
+                pool["pv_scale"], row, 1, axis=1)
+    return cache
+
+
+def write_slot_cache(pool, slot, cache):
+    """Fold a ``slot_cache_view`` batch-1 cache back into the pool.
+    Only the slot's WRITABLE state returns: k/v (+ scales); the prefix
+    planes are read-only to aliasers and ``pos`` install stays with the
+    caller (the lane's conditional slot-field writes)."""
+    pool = dict(pool)
+    for name in ("k", "v", "k_scale", "v_scale"):
+        if name in pool:
+            pool[name] = jax.lax.dynamic_update_slice_in_dim(
+                pool[name], cache[name], slot, axis=1)
+    return pool
+
+
+def fold_cache(pool, cache):
+    """Fold a full-batch ``cache_view`` cache back into the pool after a
+    decode/verify step: k/v planes and scale planes. The gathered
+    ``pk``/``pv`` views are DERIVED state and never fold back."""
+    upd = {"k": cache["k"], "v": cache["v"]}
+    if "k_scale" in pool:
+        upd["k_scale"] = cache["k_scale"]
+        upd["v_scale"] = cache["v_scale"]
+    return dict(pool, **upd)
 
 
 def kv_spec(mesh, n_head):
@@ -200,7 +302,10 @@ def pool_shardings(mesh, pool, n_head):
     saving evaporates — same lesson as the pipeline engine's opt state)."""
     kv = NamedSharding(mesh, kv_spec(mesh, n_head))
     rep = NamedSharding(mesh, P())
-    return {name: (kv if name in ("k", "v") else rep) for name in pool}
+    # Prefix planes share the k/v rank/layout, so the same head-sharded
+    # spec applies; scale planes are small — replicate them.
+    return {name: (kv if name in ("k", "v", "pk", "pv") else rep)
+            for name in pool}
 
 
 def shard_pool(mesh, pool, n_head):

@@ -52,14 +52,21 @@ class QueueFull(RuntimeError):
     always clamped to [0, RETRY_AFTER_CAP_S] so backoff math cannot go
     negative or absurd on a cold completions window), and the
     ``replica_id`` of the rejecting engine (None outside a fleet) so a
-    router can attribute the shed to one breaker."""
+    router can attribute the shed to one breaker.
+
+    ``swap_eligible`` distinguishes "truly full" from "full but the KV
+    hierarchy can free a slot by swapping an idle session to host RAM"
+    (engine._augment_queue_full sets it and arms the swap): the caller
+    should retry after ``retry_after_s`` instead of failing over —
+    capacity is about to appear on THIS replica."""
 
     def __init__(self, message, queue_depth=None, retry_after_s=None,
-                 replica_id=None):
+                 replica_id=None, swap_eligible=False):
         super().__init__(message)
         self.queue_depth = queue_depth
         self.retry_after_s = retry_after_s
         self.replica_id = replica_id
+        self.swap_eligible = swap_eligible
 
 
 class Request(object):
@@ -125,6 +132,11 @@ class Scheduler(object):
         self.replica_id = replica_id
         self.queue = collections.deque()
         self.running = {}           # slot -> Request (prefilling | decoding)
+        # rid -> Request in the ``swapped`` phase: mid-decode but holding
+        # NO slot — its device state lives in the host swap store
+        # (kv_hierarchy.offload). Insertion order IS swap-out order, so
+        # next_swap_in() resumes the longest-waiting session first.
+        self.swapped = {}
         self.completed = {}         # rid -> Request (incl. cancelled)
         self._ids = itertools.count()
         # Telemetry is strictly additive: tracer gets lifecycle spans,
@@ -276,6 +288,43 @@ class Scheduler(object):
             return True
         return False
 
+    # ------------------------------------------------------ host offload
+
+    def swap_out(self, req):
+        """Move a DECODING request out of its slot into the ``swapped``
+        phase. The engine owns the device side (capture the slot to the
+        host store, then deactivate it); this records only the truth
+        that the session is paused and slotless."""
+        assert req.phase == "decoding", req.phase
+        self.running.pop(req.slot)
+        req.slot = None
+        req.phase = "swapped"
+        self.swapped[req.rid] = req
+        if self.tracer is not None:
+            self.tracer.instant("request/swapped_out", tid=req.rid,
+                                rid=req.rid, tokens=len(req.tokens))
+
+    def next_swap_in(self):
+        """The longest-swapped session, or None — resume-first fairness:
+        a swapped session outranks fresh queue admissions for the next
+        free slot, so swaps time-slice the slot set instead of starving
+        whoever lost the first eviction."""
+        return next(iter(self.swapped.values()), None)
+
+    def swap_in(self, req, slot):
+        """Resume a swapped request into ``slot`` (need not be the slot
+        it was captured from — the record carries every positional
+        fact). The engine restores the device state before the next
+        program call."""
+        self.swapped.pop(req.rid)
+        req.slot = slot
+        req.phase = "decoding"
+        self.running[slot] = req
+        if self.tracer is not None:
+            self.tracer.instant("request/swapped_in", tid=req.rid,
+                                rid=req.rid, slot=slot,
+                                tokens=len(req.tokens))
+
     # -------------------------------------------------------- completion
 
     def complete(self, slot):
@@ -309,6 +358,9 @@ class Scheduler(object):
             return False
         if req.phase == "queued":
             self.queue.remove(req)
+        elif req.phase == "swapped":
+            self.swapped.pop(req.rid)  # slotless; host record is the
+            # engine's to drop (hierarchy on_release)
         else:
             self.running.pop(req.slot)
             req.slot = None
@@ -336,9 +388,14 @@ class Scheduler(object):
         replayed stream bit-identical (the positional fold_in(seed, pos)
         rng names every draw by absolute position — see
         engine._replay_requests). Returns the requeued requests in rid
-        order."""
-        reqs = sorted(self.running.values(), key=lambda r: r.rid)
+        order. SWAPPED sessions requeue too: their host swap records
+        described a pool that no longer exists (the engine drops them
+        via hierarchy reset), but the request records are the durable
+        truth and replay rebuilds the stream bit-identically."""
+        reqs = sorted(list(self.running.values())
+                      + list(self.swapped.values()), key=lambda r: r.rid)
         self.running.clear()
+        self.swapped.clear()
         for req in reversed(reqs):
             req.slot = None
             req.phase = "queued"
@@ -353,7 +410,8 @@ class Scheduler(object):
 
     @property
     def idle(self):
-        return not self.queue and not self.running
+        return (not self.queue and not self.running
+                and not self.swapped)
 
     def occupancy(self):
         return len(self.running) / float(self.num_slots)

@@ -104,6 +104,16 @@ class WorkloadSpec:
     # tokens to the prompt length (repetition-heavy — text repeats, and
     # the n-gram drafter needs matches); 0 draws uniform random tokens.
     phrase_len: int = 8
+    # Shared system-prompt pool: > 0 pre-draws this many fixed prefixes
+    # of ``prefix_tokens`` tokens each and prepends one to every prompt,
+    # chosen by a Zipf(``prefix_zipf_a``) rank — a few prefixes dominate
+    # (the shape of real system-prompt traffic), which is exactly what a
+    # shared-prefix KV cache exploits. 0 disables (and keeps streams
+    # byte-identical to specs that predate this knob: the pool draws
+    # come AFTER every legacy draw in RandomState order).
+    prefix_pool: int = 0
+    prefix_tokens: int = 32
+    prefix_zipf_a: float = 1.5
     temperature: float = 0.0
     # JSONL trace to replay when arrival == 'trace' (see replay_trace).
     trace_path: Optional[str] = None
@@ -136,6 +146,17 @@ class WorkloadSpec:
             if d not in LENGTH_DISTS:
                 raise ValueError("unknown length distribution {!r}; one "
                                  "of {}".format(d, LENGTH_DISTS))
+        if self.prefix_pool < 0:
+            raise ValueError("prefix_pool must be >= 0, got "
+                             "{}".format(self.prefix_pool))
+        if self.prefix_pool > 0:
+            if self.prefix_tokens < 1:
+                raise ValueError("prefix_tokens must be >= 1 when "
+                                 "prefix_pool > 0, got "
+                                 "{}".format(self.prefix_tokens))
+            if self.prefix_zipf_a <= 1.0:
+                raise ValueError("prefix_zipf_a must be > 1, got "
+                                 "{}".format(self.prefix_zipf_a))
 
     # ---------------------------------------------------------- arrivals
 
@@ -171,15 +192,34 @@ class WorkloadSpec:
                         self.output_mean, self.output_sigma,
                         self.output_zipf_a, self.output_min,
                         self.output_max)
+        # Shared prefixes are drawn ONCE, after all legacy draws, so a
+        # prefix_pool=0 spec consumes the RandomState identically to
+        # specs written before the knob existed.
+        pool = None
+        if self.prefix_pool > 0:
+            pool = rng.randint(0, self.vocab_size,
+                               size=(self.prefix_pool, self.prefix_tokens))
         reqs = []
         for i in range(self.n_requests):
             n = int(plens[i])
-            if self.phrase_len > 0:
+            prefix = None
+            if pool is not None:
+                # Zipf rank folded onto the pool: rank 1 (most of the
+                # mass) is prefix 0, so a small number of prefixes serve
+                # most requests.
+                rank = int(rng.zipf(self.prefix_zipf_a))
+                prefix = pool[(rank - 1) % self.prefix_pool]
+                n = max(n - prefix.size, 0)
+            if n == 0:
+                toks = np.empty((0,), dtype=int)
+            elif self.phrase_len > 0:
                 phrase = rng.randint(0, self.vocab_size,
                                      size=(min(self.phrase_len, n),))
                 toks = np.tile(phrase, -(-n // phrase.size))[:n]
             else:
                 toks = rng.randint(0, self.vocab_size, size=(n,))
+            if prefix is not None:
+                toks = np.concatenate([prefix, toks])
             reqs.append(LoadRequest(
                 arrival_s=float(arrivals[i]),
                 prompt=toks.astype(np.int32),

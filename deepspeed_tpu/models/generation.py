@@ -103,11 +103,26 @@ def _forward(params, cfg, ids, cache, last_only=False):
     sequence lengths (the serving engine's slots) share one program.
     ``last_only`` evaluates the LM head on the final position only (the
     prefill path — sampling reads just that row, and a [B, Tp, vocab]
-    fp32 buffer would otherwise dominate prefill memory)."""
+    fp32 buffer would otherwise dominate prefill memory).
+
+    KV-hierarchy dispatch is DATA-DRIVEN off the cache dict
+    (inference/kv_hierarchy): an int8 ``k`` plane means frontier writes
+    quantize (codes + per-(head, position) ``k_scale``/``v_scale``) and
+    attention dequantizes — in-block in the q8 flash kernel, before the
+    einsum otherwise; a ``pk`` key means each row's positions
+    ``< pbase[b]`` resolve to its aliased read-only prefix plane via a
+    per-position SELECT. The select is elementwise — no arithmetic — and
+    the prefix entries are bit-identical to what the row's own prefill
+    would have written (causality: position p's k/v depend only on
+    tokens <= p, which match by construction), so aliased and private
+    greedy streams are bit-identical. A plain cache hits neither branch
+    and lowers exactly as before."""
     B, S = ids.shape
     nh, hd = cfg.n_head, cfg.n_embd // cfg.n_head
     pos = cache["pos"]                                 # [B] row frontiers
     max_len = cache["k"].shape[3]
+    int8 = cache["k"].dtype == jnp.int8
+    has_prefix = "pk" in cache
 
     eps = cfg.layer_norm_epsilon
     wte = params["wte"].astype(cfg.dtype)
@@ -129,12 +144,35 @@ def _forward(params, cfg, ids, cache, last_only=False):
         mask = k_pos[None, None, :] <= q_pos[:, :, None]  # [B, S, max_len]
         neg = jnp.finfo(jnp.float32).min
     k_cache, v_cache = cache["k"], cache["v"]
+    if int8:
+        ks_cache, vs_cache = cache["k_scale"], cache["v_scale"]
+    if has_prefix:
+        pbase = cache["pbase"]                         # [B] aliased spans
+        # Select masks against the full plane length; pad positions can
+        # never be selected because pbase <= prefix_len <= max_len.
+        psel = jnp.arange(max_len)[None, None, :, None] < \
+            pbase[:, None, None, None]                 # [B, 1, T, 1]
+        psel_s = psel[..., 0]                          # [B, 1, T]
+
+        def pad_prefix(p):
+            # [B, H, prefix_len, ...] -> [B, H, max_len, ...]; the pad
+            # is inert (never selected), zeros keep it cheap.
+            if p.shape[2] == max_len:
+                return p
+            pad = [(0, 0)] * p.ndim
+            pad[2] = (0, max_len - p.shape[2])
+            return jnp.pad(p, pad)
 
     def write_rows(cache_l, new):
         # [B, H, T, D] cache plane <- [B, H, S, D] at each row's frontier
         # (vmapped dynamic_update_slice lowers to one scatter).
         return jax.vmap(lambda c, n, p: jax.lax.dynamic_update_slice(
             c, n, (0, p, 0)))(cache_l, new, pos)
+
+    def write_scale_rows(cache_l, new):
+        # [B, H, T] scale plane <- [B, H, S] at each row's frontier.
+        return jax.vmap(lambda c, n, p: jax.lax.dynamic_update_slice(
+            c, n, (0, p)))(cache_l, new, pos)
 
     for i in range(cfg.n_layer):
         blk = params["h_{}".format(i)]
@@ -144,22 +182,54 @@ def _forward(params, cfg, ids, cache, last_only=False):
         q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
-        k_cache = k_cache.at[i].set(write_rows(k_cache[i], k))
-        v_cache = v_cache.at[i].set(write_rows(v_cache[i], v))
+        if int8:
+            kq, ks = decode_attention.quantize_kv(k)
+            vq, vs = decode_attention.quantize_kv(v)
+            k_cache = k_cache.at[i].set(write_rows(k_cache[i], kq))
+            v_cache = v_cache.at[i].set(write_rows(v_cache[i], vq))
+            ks_cache = ks_cache.at[i].set(write_scale_rows(ks_cache[i], ks))
+            vs_cache = vs_cache.at[i].set(write_scale_rows(vs_cache[i], vs))
+        else:
+            k_cache = k_cache.at[i].set(write_rows(k_cache[i], k))
+            v_cache = v_cache.at[i].set(write_rows(v_cache[i], v))
+        # Effective planes: the row's own just-written plane, with the
+        # aliased prefix selected in below pbase[b] (codes AND scales —
+        # both tiers compose).
+        k_eff, v_eff = k_cache[i], v_cache[i]
+        if int8:
+            ks_eff, vs_eff = ks_cache[i], vs_cache[i]
+        if has_prefix:
+            k_eff = jnp.where(psel, pad_prefix(cache["pk"][i]), k_eff)
+            v_eff = jnp.where(psel, pad_prefix(cache["pv"][i]), v_eff)
+            if int8:
+                ks_eff = jnp.where(
+                    psel_s, pad_prefix(cache["pk_scale"][i]), ks_eff)
+                vs_eff = jnp.where(
+                    psel_s, pad_prefix(cache["pv_scale"][i]), vs_eff)
         if use_flash:
             # Fused QK-score + online softmax + PV over the cache plane,
             # frontier-aware: blocks past pos[b]+S-1 are skipped. The
             # cache was just written, so pos is the PRE-write frontier
-            # the kernel's mask convention expects.
-            y = decode_attention.flash_decode_attention(
-                q, k_cache[i], v_cache[i], pos,
-                scale=1.0 / float(hd) ** 0.5)
+            # the kernel's mask convention expects. The q8 family
+            # dequantizes in-block from codes + scales.
+            if int8:
+                y = decode_attention.flash_decode_attention_q8(
+                    q, k_eff, v_eff, ks_eff, vs_eff, pos,
+                    scale=1.0 / float(hd) ** 0.5)
+            else:
+                y = decode_attention.flash_decode_attention(
+                    q, k_eff, v_eff, pos, scale=1.0 / float(hd) ** 0.5)
         else:
-            att = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache[i]).astype(
+            if int8:
+                k_eff = decode_attention.dequantize_kv(k_eff, ks_eff,
+                                                       cfg.dtype)
+                v_eff = decode_attention.dequantize_kv(v_eff, vs_eff,
+                                                       cfg.dtype)
+            att = jnp.einsum("bhqd,bhkd->bhqk", q, k_eff).astype(
                 jnp.float32) / jnp.sqrt(hd)
             att = jnp.where(mask[:, None], att, neg)
             att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
-            y = jnp.einsum("bhqk,bhkd->bhqd", att, v_cache[i])
+            y = jnp.einsum("bhqk,bhkd->bhqd", att, v_eff)
         y = y.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_embd)
         x = x + _dense(y, blk["attn"]["c_proj"])
         h = _ln(x, blk["ln_2"], eps)
@@ -172,7 +242,12 @@ def _forward(params, cfg, ids, cache, last_only=False):
     x = _ln(x, params["ln_f"], eps)
     logits = jnp.einsum("bsc,vc->bsv", x.astype(jnp.float32),
                         params["wte"].astype(jnp.float32))
-    return logits, {"k": k_cache, "v": v_cache, "pos": pos + S}
+    # dict(cache, ...) — NOT a fresh literal — so hierarchy keys (scale
+    # planes, prefix views) survive the decode scan's cache threading.
+    out = dict(cache, k=k_cache, v=v_cache, pos=pos + S)
+    if int8:
+        out["k_scale"], out["v_scale"] = ks_cache, vs_cache
+    return logits, out
 
 
 def append_forward(params, cfg, ids, cache, n_valid=None):

@@ -90,6 +90,7 @@ def _time_candidate(run, repeats):
 _KERNEL_MIN_BLOCK = {
     "flash_attention": 128,
     "decode_attention": 128,
+    "decode_attention_q8": 128,
 }
 
 

@@ -96,6 +96,39 @@ def decode_signature(b, h, s, t_kv, d, dtype):
 
 
 # ---------------------------------------------------------------------------
+# int8 KV quantization — the storage format of the KV hierarchy's
+# compressed tier (inference/kv_hierarchy/). Symmetric per-(head, position)
+# scales: each written position gets its own scale, so APPENDING never
+# retroactively re-quantizes earlier positions (a running per-head amax
+# would corrupt history on every new outlier). The scale planes ride the
+# pool as fp32 ``[..., T]`` arrays — 2 bytes/position of overhead against
+# the (itemsize-1)*D saved per position.
+# ---------------------------------------------------------------------------
+
+# Scale floor: all-zero rows (unwritten cache positions) quantize to zero
+# codes with this scale instead of dividing by zero.
+_Q8_EPS = 1e-8
+
+
+def quantize_kv(x):
+    """Quantize ``[..., D]`` k/v rows to int8 with per-row symmetric
+    scales. Returns ``(codes int8 [..., D], scale fp32 [...])`` where
+    ``codes * scale[..., None]`` reconstructs x to within scale/2 per
+    element (the parity bound tests pin)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax / 127.0, _Q8_EPS)
+    codes = jnp.clip(jnp.round(xf / scale[..., None]), -127.0, 127.0)
+    return codes.astype(jnp.int8), scale
+
+
+def dequantize_kv(codes, scale, dtype=jnp.float32):
+    """Inverse of ``quantize_kv``: ``codes [..., D]`` int8 with per-row
+    ``scale [...]`` back to ``dtype``."""
+    return (codes.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
 # Reference (pure jnp) — ground truth for parity tests and the fallback for
 # shapes the kernel does not support. Mirrors models/generation.py's cache
 # attention (einsum scores over the full plane, frontier mask, fp32
@@ -120,6 +153,20 @@ def decode_attention_reference(q, k, v, pos, scale=None):
     s = jnp.where(mask[:, None], s, jnp.finfo(jnp.float32).min)
     att = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", att, v, precision=prec)
+
+
+def decode_attention_q8_reference(q, k, v, k_scale, v_scale, pos,
+                                  scale=None):
+    """int8-cache ground truth: dequantize the whole plane, then the
+    dense reference. The q8 kernel must match THIS — the engine's einsum
+    (flag-off) path computes exactly this, so kernel-on and kernel-off
+    serving agree on the same dequantized math.
+
+    k, v: [B, H, T, D] int8 codes; k_scale, v_scale: [B, H, T] fp32
+    per-position scales."""
+    kf = dequantize_kv(k, k_scale, q.dtype)
+    vf = dequantize_kv(v, v_scale, q.dtype)
+    return decode_attention_reference(q, kf, vf, pos, scale=scale)
 
 
 # ---------------------------------------------------------------------------
@@ -249,21 +296,159 @@ def _flash_decode_pallas(q, k, v, pos, scale, block_k):
 
 
 # ---------------------------------------------------------------------------
-# Block selection — autotuner integration (kernel family
-# "decode_attention"; see ops/autotuner.py and tests/perf/autotune_sweep.py)
+# int8 kernel (family "decode_attention_q8") — the same online-softmax
+# program over int8 k/v planes, dequantizing IN-BLOCK: each kv block's
+# codes meet their per-position scales in VMEM, so HBM traffic on the
+# length dim drops ~4x (int8 codes + one fp32 scale lane vs fp32 rows)
+# and the pool stores int8. Frontier clamping, straddle-only masking and
+# the scratch accumulator are identical to the fp kernel.
+# ---------------------------------------------------------------------------
+
+def _decode_kernel_q8(pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                      *scratch, s_len, block_k, single_kv):
+    b_ = pl.program_id(0)
+    j = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+    pos_b = pos_ref[b_]
+    last = (pos_b + s_len - 1) // block_k
+
+    def dequant():
+        # In-block dequant: int8 codes * fp32 per-position scales
+        # ([block_k, 1] broadcast over [block_k, d]). k stays fp32 into
+        # the score GEMM; v casts to the output dtype for _pv_rowsum,
+        # matching the fp kernel's operand dtype there.
+        k_f = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]
+        v_f = (v_ref[0, 0].astype(jnp.float32)
+               * vs_ref[0, 0]).astype(o_ref.dtype)
+        return k_f, v_f
+
+    def scores(k_f):
+        s = jax.lax.dot_general(q_ref[0, 0].astype(jnp.float32), k_f,
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=_mxu_precision(jnp.float32))
+
+        def straddling():
+            q_pos = pos_b + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            return jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+        return jax.lax.cond((j + 1) * block_k - 1 <= pos_b,
+                            lambda: s, straddling)
+
+    if single_kv:
+        k_f, v_f = dequant()
+        s = scores(k_f)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = _exp_lowp(s - m, o_ref.dtype)
+        pv, l = _pv_rowsum(p, v_f)
+        l = jnp.maximum(l, 1e-30)
+        o_ref[0, 0] = (pv / l).astype(o_ref.dtype)
+        return
+
+    acc, m_s, l_s = scratch
+
+    @pl.when(j == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+
+    @pl.when(j <= last)
+    def _compute():
+        k_f, v_f = dequant()
+        s = scores(k_f)
+        m_prev = m_s[:, 0:1]
+        l_prev = l_s[:, 0:1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = _exp_lowp(s - m_new, o_ref.dtype)
+        pv, l_cur = _pv_rowsum(p, v_f)
+        l_new = alpha * l_prev + l_cur
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+        acc[...] = acc[...] * alpha + pv
+
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        l = jnp.maximum(l_s[:, 0:1], 1e-30)
+        o_ref[0, 0] = (acc[...] / l).astype(o_ref.dtype)
+
+
+def _flash_decode_q8_pallas(q, k, v, k_scale, v_scale, pos, scale, block_k):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    t_kv = k.shape[2]
+    n_kv = t_kv // block_k
+    q = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    pos = pos.astype(jnp.int32)
+    # Scales block along the length dim like k/v, so they need length
+    # second-minor too: [B, H, T] -> [B, H, T, 1]. The 1-lane trailing
+    # axis pads to a full lane tile in VMEM (the _STATS_LANES trade: a
+    # few wasted lanes for a legal layout).
+    k_scale = k_scale.astype(jnp.float32)[..., None]
+    v_scale = v_scale.astype(jnp.float32)[..., None]
+    sub = _sublane(q.dtype)
+    s_blk = -(-s // sub) * sub
+    if s_blk != s:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, s_blk - s), (0, 0)))
+
+    def kv_index(b_, h_, j, pos_ref):
+        last = (pos_ref[b_] + s - 1) // block_k
+        return (b_, h_, jnp.minimum(j, last), 0)
+
+    def q_index(b_, h_, j, pos_ref):
+        return (b_, h_, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, 1, s_blk, d), q_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, 1), kv_index),
+            pl.BlockSpec((1, 1, block_k, 1), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, s_blk, d), q_index),
+        scratch_shapes=[] if n_kv == 1 else [
+            pltpu.VMEM((s_blk, d), jnp.float32),
+            pltpu.VMEM((s_blk, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((s_blk, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel_q8, s_len=s, block_k=block_k,
+                          single_kv=n_kv == 1),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, s_blk, d), q.dtype),
+        interpret=_interpret(),
+    )(pos, q, k, v, k_scale, v_scale)
+    return out[:, :, :s] if s_blk != s else out
+
+
+# ---------------------------------------------------------------------------
+# Block selection — autotuner integration (kernel families
+# "decode_attention" and "decode_attention_q8"; see ops/autotuner.py and
+# tests/perf/autotune_sweep.py)
 # ---------------------------------------------------------------------------
 
 def _block_candidates(t_kv):
     return [bk for bk in (128, 256, 512) if bk <= t_kv and t_kv % bk == 0]
 
 
-def _autotuned_block(shape, dtype, cands, default, arrays=None):
-    """Consult the autotuner for a decode block size. ``arrays`` (q, k, v,
-    pos concrete values) enables an online sweep under DS_TPU_AUTOTUNE;
-    without them (traced engine calls, bench stamping) only the
-    bundled/user tables are consulted. The sweep times the WORST-CASE
-    frontier (pos = t - s: every block active) so the tuned tile is the
-    one the end of a long generation runs on."""
+def _autotuned_block(shape, dtype, cands, default, arrays=None,
+                     family="decode_attention"):
+    """Consult the autotuner for a decode block size. ``arrays`` (operand
+    concrete values: q, k, v for the fp family; q, codes, codes, scales,
+    scales for q8) enables an online sweep under DS_TPU_AUTOTUNE; without
+    them (traced engine calls, bench stamping) only the bundled/user
+    tables are consulted. The sweep times the WORST-CASE frontier
+    (pos = t - s: every block active) so the tuned tile is the one the
+    end of a long generation runs on."""
     from deepspeed_tpu.ops import autotuner
 
     b, h, s, t_kv, d = shape
@@ -272,17 +457,25 @@ def _autotuned_block(shape, dtype, cands, default, arrays=None):
 
     def make_run(cand):
         (bk,) = cand
-        q, k, v, _ = arrays
         pos = jnp.full((b,), t_kv - s, jnp.int32)
         scale = 1.0 / (d ** 0.5)
-        jitted = jax.jit(functools.partial(
-            _flash_decode_pallas, scale=scale, block_k=int(bk)))
+        if family == "decode_attention_q8":
+            q, kq, vq, ks, vs = arrays[:5]
+            jitted = jax.jit(functools.partial(
+                _flash_decode_q8_pallas, scale=scale, block_k=int(bk)))
 
-        def run():
-            return jitted(q, k, v, pos)
+            def run():
+                return jitted(q, kq, vq, ks, vs, pos)
+        else:
+            q, k, v = arrays[:3]
+            jitted = jax.jit(functools.partial(
+                _flash_decode_pallas, scale=scale, block_k=int(bk)))
+
+            def run():
+                return jitted(q, k, v, pos)
         return run
 
-    choice = autotuner.autotune("decode_attention", sig, cand_lists,
+    choice = autotuner.autotune(family, sig, cand_lists,
                                 make_run, default=[default])
     bk = int(choice[0] if isinstance(choice, (list, tuple)) else choice)
     # A hand-edited table entry must not break dispatch: reject tiles the
@@ -301,13 +494,15 @@ def planned_block_k(b, h, s, t_kv, d, dtype):
     return _autotuned_block((b, h, s, t_kv, d), dtype, cands, default)
 
 
-def resolve_decode_block(q, k, block_k=None, v=None, pos=None):
-    """The ONE block-selection policy for flash_decode_attention: an
-    explicit ``block_k`` (arg or DS_TPU_FLASH_DECODE_BLOCK env, for tests
-    and A/B experiments) is honored when legal; otherwise the autotuner
-    table / default — with an online sweep when the call is eager on TPU
-    and DS_TPU_AUTOTUNE is on (v/pos supply the sweep operands). Returns
-    None when the shape must take the dense fallback."""
+def resolve_decode_block(q, k, block_k=None, v=None, pos=None, scales=None,
+                         family="decode_attention"):
+    """The ONE block-selection policy for flash_decode_attention (both
+    families): an explicit ``block_k`` (arg or DS_TPU_FLASH_DECODE_BLOCK
+    env, for tests and A/B experiments) is honored when legal; otherwise
+    the autotuner table / default — with an online sweep when the call is
+    eager on TPU and DS_TPU_AUTOTUNE is on (v/pos — plus ``scales`` for
+    q8 — supply the sweep operands). Returns None when the shape must
+    take the dense fallback."""
     import jax.core
 
     t_kv = k.shape[2]
@@ -323,13 +518,14 @@ def resolve_decode_block(q, k, block_k=None, v=None, pos=None):
     b, h, s, d = q.shape
     cands = _block_candidates(t_kv)
     default = _DEFAULT_BLOCK_K if _DEFAULT_BLOCK_K in cands else cands[-1]
+    operands = (q, k, v, pos) + (tuple(scales) if scales else ())
     traced = any(isinstance(x, jax.core.Tracer)
-                 for x in (q, k, v, pos) if x is not None)
+                 for x in operands if x is not None)
     arrays = None
     if not traced and not _interpret() and v is not None and pos is not None:
-        arrays = (q, k, v, pos)
+        arrays = (q, k, v) + (tuple(scales) if scales else ())
     return _autotuned_block((b, h, s, t_kv, d), q.dtype, cands, default,
-                            arrays=arrays)
+                            arrays=arrays, family=family)
 
 
 # ---------------------------------------------------------------------------
@@ -367,6 +563,36 @@ def _decode_partitioned(scale, block_k):
     return cp
 
 
+@functools.lru_cache(maxsize=None)
+def _decode_q8_partitioned(scale, block_k):
+    def f(q, k, v, k_scale, v_scale, pos):
+        return _flash_decode_q8_pallas(q, k, v, k_scale, v_scale, pos,
+                                       scale, block_k)
+
+    cp = custom_partitioning(f)
+
+    def shardings(mesh, q_sharding):
+        b, h = _bh_spec(q_sharding)
+        full = NamedSharding(mesh, P(b, h, None, None))
+        sc = NamedSharding(mesh, P(b, h, None))
+        pos_sh = NamedSharding(mesh, P(b))
+        return (full, full, full, sc, sc, pos_sh), (full,)
+
+    def infer(mesh, arg_shapes, shape):
+        return shardings(mesh, arg_shapes[0].sharding)[1][0]
+
+    def partition(mesh, arg_shapes, result_shape):
+        args, outs = shardings(mesh, arg_shapes[0].sharding)
+        return mesh, f, outs[0], args
+
+    # Scale planes shard exactly like their codes minus the head dim:
+    # [b, h, s] follows the kv sharding, length replicated.
+    _def_partition(cp, partition, infer,
+                   "b h t d, b h s d, b h s d, b h s, b h s, b -> b h t d",
+                   ("t", "d", "s"))
+    return cp
+
+
 # ---------------------------------------------------------------------------
 # Public entry point
 # ---------------------------------------------------------------------------
@@ -398,3 +624,29 @@ def flash_decode_attention(q, k, v, pos, scale=None, block_k=None):
     if _use_custom_partitioning():
         return _decode_partitioned(float(scale), int(bk))(q, k, v, pos)
     return _flash_decode_pallas(q, k, v, pos, float(scale), int(bk))
+
+
+def flash_decode_attention_q8(q, k, v, k_scale, v_scale, pos, scale=None,
+                              block_k=None):
+    """int8-cache flash decode: same contract as ``flash_decode_attention``
+    but k/v are int8 codes with fp32 per-(head, position) scales
+    (``quantize_kv``'s output layout, [B, H, T] alongside [B, H, T, D]
+    planes). Dequantization happens in-block inside the kernel; shapes
+    the kernel cannot take fall back to ``decode_attention_q8_reference``
+    (dequantize-then-dense). Autotuned under the "decode_attention_q8"
+    family — int8 operands shift the compute/bandwidth balance, so tiles
+    are tuned separately from the fp family."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    bk = resolve_decode_block(q, k, block_k=block_k, v=v, pos=pos,
+                              scales=(k_scale, v_scale),
+                              family="decode_attention_q8")
+    if bk is None:
+        return decode_attention_q8_reference(q, k, v, k_scale, v_scale,
+                                             pos, scale=scale)
+    if _use_custom_partitioning():
+        return _decode_q8_partitioned(float(scale), int(bk))(
+            q, k, v, k_scale, v_scale, pos)
+    return _flash_decode_q8_pallas(q, k, v, k_scale, v_scale, pos,
+                                   float(scale), int(bk))

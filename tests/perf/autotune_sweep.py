@@ -20,8 +20,10 @@ table, schema-validating the result before writing.
 Usage: python tests/perf/autotune_sweep.py
            [--shapes b8t1024,b4t2048,...]
            [--decode-shapes b16t1024,b1s32t1024,...]
+           [--decode-q8-shapes b16t1024,b16s5t1024,...]
        (decode specs are bB[sS]tT; s>1 sweeps the chunked-prefill
-       append-attention shapes.)
+       append-attention shapes; the q8 list sweeps the int8-KV kernel
+       family "decode_attention_q8" at the same grammar.)
 """
 
 import argparse
@@ -45,7 +47,8 @@ from deepspeed_tpu.ops import autotuner
 from deepspeed_tpu.ops.transformer.kernels.attention import (
     _bwd_mode, flash_attention, flash_signature)
 from deepspeed_tpu.ops.transformer.kernels.decode_attention import (
-    decode_signature, flash_decode_attention)
+    decode_signature, flash_decode_attention, flash_decode_attention_q8,
+    quantize_kv)
 
 # (batch, seq) grid — matches bench.py --sweep; heads/dim are GPT-2
 # medium's (the autotune signature keys on the full shape).
@@ -64,6 +67,23 @@ DEFAULT_SHAPES = "b8t1024,b12t1024,b16t1024,b4t2048,b8t2048,b2t4096,b4t4096"
 DEFAULT_DECODE_SHAPES = ("b16t1024,b16t2048,b8t2048,b8t4096,"
                          "b1s32t1024,b1s32t2048,b1s64t2048,"
                          "b16s5t1024,b16s5t2048,b8s5t2048")
+
+# int8-KV ("decode_attention_q8") grid — same grammar, the serving and
+# speculative-verify shapes the engine dispatches with int8_kv on. The
+# q8 kernel streams HALF the cache bytes per kv tile (int8 codes + a
+# thin fp32 scale row), so its winning tile need not match the fp one —
+# it gets its own family and its own swept entries.
+DEFAULT_DECODE_Q8_SHAPES = ("b16t1024,b16t2048,b8t2048,b8t4096,"
+                            "b1s32t1024,b16s5t1024,b16s5t2048")
+
+
+def _parse_decode_spec(spec):
+    # Spec grammar: bB[sS]tT — s defaults to 1 (pure decode); s>1 is a
+    # chunked-prefill append slice (or the spec_k+1 verify width).
+    body, t = spec[1:].split("t")
+    b, s = (int(x) for x in body.split("s")) if "s" in body \
+        else (int(body), 1)
+    return b, s, int(t)
 
 
 def sweep_flash(args, swept_keys):
@@ -94,12 +114,7 @@ def sweep_decode(args, swept_keys):
         spec = spec.strip()
         if not spec:
             continue
-        # Spec grammar: bB[sS]tT — s defaults to 1 (pure decode); s>1 is
-        # a chunked-prefill append slice.
-        body, t = spec[1:].split("t")
-        b, s = (int(x) for x in body.split("s")) if "s" in body \
-            else (int(body), 1)
-        t = int(t)
+        b, s, t = _parse_decode_spec(spec)
         q = jnp.asarray(rng.randn(b, args.heads, s, args.dim), jnp.bfloat16)
         k = jnp.asarray(rng.randn(b, args.heads, t, args.dim), jnp.bfloat16)
         v = jnp.asarray(rng.randn(b, args.heads, t, args.dim), jnp.bfloat16)
@@ -116,10 +131,36 @@ def sweep_decode(args, swept_keys):
         print("swept decode", spec, flush=True)
 
 
+def sweep_decode_q8(args, swept_keys):
+    rng = np.random.RandomState(2)
+    for spec in args.decode_q8_shapes.split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        b, s, t = _parse_decode_spec(spec)
+        q = jnp.asarray(rng.randn(b, args.heads, s, args.dim), jnp.bfloat16)
+        # Quantized planes, the exact operand layout the engine holds:
+        # int8 codes + per-(head, position) fp32 scales.
+        kq, ks = quantize_kv(jnp.asarray(
+            rng.randn(b, args.heads, t, args.dim), jnp.bfloat16))
+        vq, vs = quantize_kv(jnp.asarray(
+            rng.randn(b, args.heads, t, args.dim), jnp.bfloat16))
+        pos = jnp.full((b,), t - s, jnp.int32)
+        out = flash_decode_attention_q8(q, kq, vq, ks, vs, pos)
+        out.block_until_ready()
+        # The q8 family keys on the QUERY dtype (the codes are always
+        # int8) — same convention as resolve_decode_block.
+        swept_keys.append(autotuner.table_key(
+            "decode_attention_q8",
+            decode_signature(b, args.heads, s, t, args.dim, jnp.bfloat16)))
+        print("swept decode q8", spec, flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--shapes", default=DEFAULT_SHAPES)
     ap.add_argument("--decode-shapes", default=DEFAULT_DECODE_SHAPES)
+    ap.add_argument("--decode-q8-shapes", default=DEFAULT_DECODE_Q8_SHAPES)
     ap.add_argument("--heads", type=int, default=16)
     ap.add_argument("--dim", type=int, default=64)
     args = ap.parse_args()
@@ -127,6 +168,7 @@ def main():
     swept_keys = []
     sweep_flash(args, swept_keys)
     sweep_decode(args, swept_keys)
+    sweep_decode_q8(args, swept_keys)
 
     user_path = autotuner._user_cache_path()
     try:

@@ -78,3 +78,15 @@ def test_blocks_must_be_multiples_of_kernel_minimum(key):
     with pytest.raises(ValueError, match="multiple"):
         autotuner.validate_table({key: {"choice": 192}})
     assert autotuner.validate_table({key: {"choice": 256}}) == 1
+
+
+DECODE_Q8_KEY = "tpu::decode_attention_q8::b16_h16_s1_t1024_d64_bfloat16"
+
+
+def test_q8_family_accepted_with_its_tile_quantum():
+    """The int8-KV decode family validates like the fp one: its own
+    128-tile quantum, so a sweep merge carrying q8 entries passes and a
+    hand-edited off-quantum tile still dies at validation time."""
+    assert autotuner.validate_table({DECODE_Q8_KEY: {"choice": [256]}}) == 1
+    with pytest.raises(ValueError, match="multiple"):
+        autotuner.validate_table({DECODE_Q8_KEY: {"choice": [192]}})

@@ -15,9 +15,10 @@ from deepspeed_tpu.models.generation import (
     _forward, as_gencfg, decode_step, generate, init_cache)
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
 from deepspeed_tpu.ops.transformer.kernels.decode_attention import (
-    BLOCK_MIN, decode_attention_reference, decode_supported,
-    flash_decode_attention, pad_cache_len, planned_block_k,
-    resolve_decode_block)
+    BLOCK_MIN, decode_attention_q8_reference, decode_attention_reference,
+    decode_supported, dequantize_kv, flash_decode_attention,
+    flash_decode_attention_q8, pad_cache_len, planned_block_k,
+    quantize_kv, resolve_decode_block)
 
 
 def qkv(rng, b, h, s, t, d, dtype=jnp.float32):
@@ -275,3 +276,91 @@ def test_generate_flag_parity_tokens_identical():
     model_on = GPT2LMHeadModel(cfg_on)
     out_on = np.asarray(generate(model_on, params, ids, 6, temperature=0.0))
     np.testing.assert_array_equal(out_on, out_off)
+
+
+# ------------------------------------------------- int8 KV (q8 family)
+
+
+def _q8_operands(rng, b, h, s, t, d, dtype=jnp.float32):
+    q = jnp.asarray(rng.randn(b, h, s, d), dtype)
+    k = jnp.asarray(rng.randn(b, h, t, d), dtype)
+    v = jnp.asarray(rng.randn(b, h, t, d), dtype)
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    return q, k, v, kq, ks, vq, vs
+
+
+def test_quantize_roundtrip_error_bound():
+    """The pinned dequant bound: |dequant(quantize(x)) - x| <= scale/2
+    per element, scale = amax/127 per (batch, head, position) row —
+    the contract engine int8 serving leans on."""
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 4, 32, 16) * 3.0, jnp.float32)
+    codes, scale = quantize_kv(x)
+    assert codes.dtype == jnp.int8 and scale.dtype == jnp.float32
+    assert scale.shape == x.shape[:-1]
+    err = np.abs(np.asarray(dequantize_kv(codes, scale)) - np.asarray(x))
+    bound = np.asarray(scale)[..., None] / 2.0 + 1e-6
+    assert (err <= bound).all(), \
+        "max dequant error {} exceeds scale/2".format(err.max())
+
+
+@pytest.mark.parametrize("block_k", [64, 128])
+def test_q8_kernel_matches_q8_reference_ragged(block_k):
+    """The q8 Pallas kernel (in-block dequant) against the dequantize-
+    then-dense reference over ragged frontiers: same codes, same scales,
+    same math — tight parity, not a quantization-noise tolerance."""
+    rng = np.random.RandomState(4)
+    q, _, _, kq, ks, vq, vs = _q8_operands(rng, 4, 2, 1, 256, 32)
+    pos = jnp.asarray([0, 3, 128, 255], jnp.int32)
+    out = flash_decode_attention_q8(q, kq, vq, ks, vs, pos,
+                                    block_k=block_k)
+    ref = decode_attention_q8_reference(q, kq, vq, ks, vs, pos)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_q8_kernel_under_jit():
+    rng = np.random.RandomState(5)
+    q, _, _, kq, ks, vq, vs = _q8_operands(rng, 3, 2, 1, 128, 16)
+    pos = jnp.asarray([5, 63, 127], jnp.int32)
+    f = jax.jit(lambda *a: flash_decode_attention_q8(*a, block_k=64))
+    np.testing.assert_allclose(
+        f(q, kq, vq, ks, vs, pos),
+        decode_attention_q8_reference(q, kq, vq, ks, vs, pos),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_q8_append_rows_multi_query():
+    """The speculative-verify / chunked-append shape (S>1): the q8
+    kernel's intra-row causal stagger must match the reference's."""
+    rng = np.random.RandomState(6)
+    q, _, _, kq, ks, vq, vs = _q8_operands(rng, 2, 2, 5, 128, 16)
+    pos = jnp.asarray([17, 99], jnp.int32)
+    out = flash_decode_attention_q8(q, kq, vq, ks, vs, pos, block_k=64)
+    ref = decode_attention_q8_reference(q, kq, vq, ks, vs, pos)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_q8_close_to_fp_within_quantization_noise():
+    """q8 against the FP reference on the original planes: the output
+    error is bounded by quantization noise (loose tolerance — int8 is
+    lossy by design; this pins 'close', the engine tests pin 'does not
+    collapse')."""
+    rng = np.random.RandomState(7)
+    q, k, v, kq, ks, vq, vs = _q8_operands(rng, 2, 2, 1, 128, 32)
+    pos = jnp.asarray([64, 127], jnp.int32)
+    out = flash_decode_attention_q8(q, kq, vq, ks, vs, pos, block_k=64)
+    ref = decode_attention_reference(q, k, v, pos)
+    np.testing.assert_allclose(out, ref, rtol=0.0, atol=0.05)
+
+
+def test_q8_unsupported_length_falls_back_to_reference():
+    """T below the kernel minimum: dispatch must land on the q8 dense
+    fallback, not crash — and the numbers are the reference's exactly."""
+    rng = np.random.RandomState(8)
+    t = BLOCK_MIN // 2
+    q, _, _, kq, ks, vq, vs = _q8_operands(rng, 2, 2, 1, t, 16)
+    pos = jnp.asarray([0, t - 1], jnp.int32)
+    out = flash_decode_attention_q8(q, kq, vq, ks, vs, pos)
+    ref = decode_attention_q8_reference(q, kq, vq, ks, vs, pos)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

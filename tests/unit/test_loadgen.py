@@ -107,6 +107,65 @@ def test_workload_validation():
         _spec(arrival="trace")          # no trace_path
     with pytest.raises(ValueError):
         _spec(prompt_dist="cauchy")
+    with pytest.raises(ValueError):
+        _spec(prefix_pool=-1)
+    with pytest.raises(ValueError):
+        _spec(prefix_pool=2, prefix_tokens=0)
+    with pytest.raises(ValueError):
+        _spec(prefix_pool=2, prefix_zipf_a=1.0)
+
+
+def test_workload_prefix_pool_zipf_reuse():
+    """The shared system-prompt pool: every prompt starts with one of
+    ``prefix_pool`` fixed heads, Zipf-skewed so a few dominate — and the
+    stream stays deterministic per seed, including the pool draws."""
+    kw = dict(seed=7, n_requests=32, prefix_pool=3, prefix_tokens=6,
+              prompt_dist="fixed", prompt_mean=12)
+    a, b = _spec(**kw).requests(), _spec(**kw).requests()
+    for x, y in zip(a, b):
+        assert np.array_equal(x.prompt, y.prompt)
+        assert x.seed == y.seed
+    heads = [tuple(r.prompt[:6]) for r in a]
+    pool = sorted(set(heads))
+    assert 1 <= len(pool) <= 3              # every head from the pool
+    counts = sorted((heads.count(h) for h in pool), reverse=True)
+    assert counts[0] > len(a) // 3          # Zipf skew: one head dominates
+    # Prompt length: the shared head REPLACES the first prefix_tokens of
+    # the drawn length (total length unchanged when it exceeds the head,
+    # floored at the head length otherwise).
+    assert all(r.prompt.size == 12 for r in a)
+    short = _spec(seed=7, n_requests=8, prefix_pool=2, prefix_tokens=10,
+                  prompt_dist="fixed", prompt_mean=4,
+                  prompt_min=4).requests()
+    assert all(r.prompt.size == 10 for r in short)
+
+
+def test_workload_prefix_pool_off_is_legacy_stream():
+    """prefix_pool=0 must consume the RandomState exactly as specs
+    written before the knob existed: the new pool draws come after every
+    legacy draw, so the legacy stream is byte-identical."""
+    legacy = _spec(seed=7).requests()
+    off = _spec(seed=7, prefix_pool=0, prefix_tokens=99,
+                prefix_zipf_a=3.0).requests()
+    for x, y in zip(legacy, off):
+        assert x.arrival_s == y.arrival_s
+        assert np.array_equal(x.prompt, y.prompt)
+        assert x.seed == y.seed
+
+
+def test_workload_prefix_pool_trace_roundtrip(tmp_path):
+    """Shared-prefix streams replay exactly through the JSONL trace
+    path (explicit token ids — the prefix structure survives)."""
+    reqs = _spec(seed=5, prefix_pool=2, prefix_tokens=6).requests()
+    path = str(tmp_path / "prefix_trace.jsonl")
+    save_trace(reqs, path)
+    back = replay_trace(path)
+    assert len(back) == len(reqs)
+    for x, y in zip(reqs, back):
+        assert x.arrival_s == y.arrival_s
+        assert np.array_equal(x.prompt, y.prompt)
+        assert x.max_new_tokens == y.max_new_tokens
+        assert x.seed == y.seed
 
 
 def test_trace_roundtrip_and_len_only_replay(tmp_path):
