@@ -313,6 +313,37 @@ _FALLBACK_METRIC_FOR = {
 }
 
 
+_ANALYSIS_SUMMARY = None
+
+
+def _analysis_summary():
+    """graftlint stamp for bench artifacts: {counts_by_rule, new,
+    baseline_size}. One AST pass over the package per process (cached);
+    a broken analyzer degrades to an error marker, never a dead bench."""
+    global _ANALYSIS_SUMMARY
+    if _ANALYSIS_SUMMARY is None:
+        try:
+            import deepspeed_tpu
+            from deepspeed_tpu import analysis
+            pkg = os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
+            baseline_path = os.path.join(pkg, "analysis", "baseline.json")
+            findings = analysis.collect_findings([pkg])
+            baseline = (analysis.load_baseline(baseline_path)
+                        if os.path.exists(baseline_path) else [])
+            new, _stale = analysis.apply_baseline(findings, baseline)
+            counts = {}
+            for f in findings:
+                counts[f.rule] = counts.get(f.rule, 0) + 1
+            _ANALYSIS_SUMMARY = {
+                "counts_by_rule": counts,
+                "new": len(new),
+                "baseline_size": len(baseline),
+            }
+        except Exception as exc:  # noqa: BLE001 — bench must not die on lint
+            _ANALYSIS_SUMMARY = {"error": f"{type(exc).__name__}: {exc}"}
+    return _ANALYSIS_SUMMARY
+
+
 def _emit(result):
     """Print the one driver-facing JSON line.
 
@@ -385,6 +416,11 @@ def _emit(result):
                                                  result["vs_baseline"])
     if fallback and _PROBE_ATTEMPTS:
         result["extra"]["probe_attempts"] = list(_PROBE_ATTEMPTS)
+    # Static health travels with every perf artifact: graftlint finding
+    # counts by rule + baseline size (docs/ANALYSIS.md), so the perf
+    # trajectory records whether the tree was contract-clean when the
+    # number was earned.
+    result["extra"].setdefault("analysis_findings", _analysis_summary())
     # flush: under the battery/supervisor stdout is a file; a later wedge
     # must not take this already-earned result line with it.
     print(json.dumps(result), flush=True)

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# One-command static health check: graftlint (JAX-contract analyzer +
+# fleet race detector, see docs/ANALYSIS.md) plus a byte-compile pass.
+# CI and tier-1 run the same analyzer via tests/unit/test_analysis_selfcheck.py,
+# so a clean ./bin/lint.sh means the selfcheck will agree.
+#
+# Usage: bin/lint.sh [extra paths...]
+set -euo pipefail
+
+repo_root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$repo_root"
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== graftlint =="
+python -m deepspeed_tpu.analysis deepspeed_tpu "$@"
+
+echo "== compileall =="
+python -m compileall -q deepspeed_tpu
+
+echo "lint: OK"

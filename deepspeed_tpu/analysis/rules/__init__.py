@@ -1,0 +1,13 @@
+"""graftlint rule registry. Each rule is ``check(ctx, config) -> findings``."""
+
+from . import determinism, donation, hostsync, recompile, threadrace
+
+RULES = {
+    "HOSTSYNC": hostsync.check,
+    "RECOMPILE": recompile.check,
+    "DONATION": donation.check,
+    "DETERMINISM": determinism.check,
+    "THREADRACE": threadrace.check,
+}
+
+__all__ = ["RULES"]

@@ -1,0 +1,68 @@
+"""Shared helper: collect ``x = jax.jit(...)`` bindings in a module.
+
+Intraprocedural and deliberately conservative: only simple ``Name`` or
+``self.<attr>``-style targets are tracked, and ``static_argnums`` /
+``donate_argnums`` are honored only when written as integer constants or
+tuples thereof. Anything fancier (dict-of-jits, returned jits, computed
+argnums) is out of scope — the rules consuming this table must only
+FLAG what the table proves, never guess.
+"""
+
+import ast
+from typing import Dict, NamedTuple, Optional, Tuple
+
+from ..core import dotted
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
+
+
+class JitBinding(NamedTuple):
+    static: Tuple[int, ...]
+    donate: Tuple[int, ...]
+    node: ast.Call
+
+
+def _int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int) \
+                    and not isinstance(elt.value, bool):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def parse_jit_call(call: ast.Call) -> Optional[JitBinding]:
+    if dotted(call.func) not in _JIT_NAMES:
+        return None
+    static: Tuple[int, ...] = ()
+    donate: Tuple[int, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            static = _int_tuple(kw.value) or ()
+        elif kw.arg == "donate_argnums":
+            donate = _int_tuple(kw.value) or ()
+    return JitBinding(static, donate, call)
+
+
+def collect_bindings(tree: ast.AST) -> Dict[str, JitBinding]:
+    """Map assigned path ("step", "self._mixed") -> JitBinding for every
+    ``<path> = jax.jit(...)`` assignment in the module."""
+    out: Dict[str, JitBinding] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        binding = parse_jit_call(node.value)
+        if binding is None:
+            continue
+        for target in node.targets:
+            path = dotted(target)
+            if path:
+                out[path] = binding
+    return out

@@ -1,0 +1,80 @@
+"""DETERMINISM: no wall-clock or unseeded RNG in replayable code.
+
+Invariant guarded: a ``WorkloadSpec`` replays bit-identically from its
+seed, and traced decode programs derive every random draw from the
+positional ``fold_in(seed, pos)`` chain — so `time.time()`, the
+process-global ``random`` module, and unseeded numpy generators are
+banned inside hot-path functions and the modules listed in
+``annotations.DETERMINISM_MODULES``. ``np.random.default_rng(seed)`` /
+``RandomState(seed)`` with an explicit seed argument are the sanctioned
+forms; ``jax.random`` is always fine (keys are explicit).
+"""
+
+import ast
+
+from ..core import Finding, dotted
+
+_CLOCK_CALLS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.now", "datetime.datetime.now", "datetime.utcnow",
+    "datetime.datetime.utcnow",
+}
+# numpy constructors that are fine IF given an explicit seed argument.
+_SEEDABLE = {"default_rng", "RandomState", "Generator", "SeedSequence",
+             "PCG64", "Philox", "MT19937", "Random"}
+
+
+def _scan(ctx, root, fixed_qual=None):
+    for node in ast.walk(root):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is None:
+            continue
+        if fixed_qual is None:
+            enc = ctx.enclosing_function(node)
+            qual = enc[1] if enc else ""
+        else:
+            qual = fixed_qual
+        if d in _CLOCK_CALLS:
+            yield Finding(
+                "DETERMINISM", ctx.relpath, node.lineno, node.col_offset, qual,
+                f"{d}() reads the wall clock in deterministic/replay code — "
+                f"inject a clock or derive from the request trace")
+            continue
+        parts = d.split(".")
+        if parts[0] == "random" and len(parts) == 2:
+            if parts[1] in _SEEDABLE and (node.args or node.keywords):
+                continue
+            yield Finding(
+                "DETERMINISM", ctx.relpath, node.lineno, node.col_offset, qual,
+                f"{d}() uses the process-global RNG — seed a dedicated "
+                f"generator or use jax.random with an explicit key")
+        elif len(parts) >= 3 and parts[0] in ("np", "numpy") and parts[1] == "random":
+            leaf = parts[-1]
+            if leaf in _SEEDABLE:
+                if node.args or node.keywords:
+                    continue
+                yield Finding(
+                    "DETERMINISM", ctx.relpath, node.lineno, node.col_offset,
+                    qual,
+                    f"{d}() constructed without an explicit seed — replay "
+                    f"paths must be reproducible from the workload seed")
+            else:
+                yield Finding(
+                    "DETERMINISM", ctx.relpath, node.lineno, node.col_offset,
+                    qual,
+                    f"{d}() draws from numpy's global RNG — use a seeded "
+                    f"Generator (np.random.default_rng(seed))")
+
+
+def check(ctx, config):
+    whole_module = any(
+        ctx.relpath == m or ctx.relpath.endswith("/" + m)
+        for m in config.determinism_modules)
+    if whole_module:
+        yield from _scan(ctx, ctx.tree)
+        return
+    for fnode, qual in ctx.hot_functions(config):
+        yield from _scan(ctx, fnode, qual)

@@ -1,0 +1,100 @@
+"""DONATION: no reads of a buffer after it was donated to a jit call.
+
+Invariant guarded: ``donate_argnums`` hands the buffer's memory to XLA;
+the Python reference left behind is a dead array whose use raises (on
+TPU) or silently aliases (on CPU) — the PR 8 committed-pool bug class,
+where the old KV pool was consulted after the mixed-step program had
+already consumed it.
+
+Scope is intraprocedural and name-based: for each call to a tracked
+jit binding, every donated argument that is a plain ``name`` or dotted
+``self.attr`` path must either be rebound by the very statement making
+the call (``pool = step(pool)``) or never read again before its next
+rebind in the same function. Textual order stands in for control flow —
+loops that wrap around are out of scope, as are aliases.
+"""
+
+import ast
+
+from ..core import Finding, dotted
+from ._jit import collect_bindings
+
+
+def _stmt_of(ctx, node):
+    cur = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = ctx.parents.get(cur)
+    return cur
+
+
+def _target_paths(stmt):
+    out = set()
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+        for e in elts:
+            if isinstance(e, ast.Starred):
+                e = e.value
+            p = dotted(e)
+            if p:
+                out.add(p)
+    return out
+
+
+def _path_events(fn, path):
+    """(lineno, kind) events for loads/stores of ``path`` inside ``fn``.
+    AugAssign targets count as loads too — ``x |= y`` reads donated x."""
+    loads, stores = [], []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Name, ast.Attribute)) and dotted(node) == path:
+            if isinstance(node.ctx, ast.Load):
+                loads.append(node)
+            elif isinstance(node.ctx, ast.Store):
+                stores.append(node)
+        elif isinstance(node, ast.AugAssign) and dotted(node.target) == path:
+            loads.append(node.target)
+    return loads, stores
+
+
+def check(ctx, config):
+    bindings = {p: b for p, b in collect_bindings(ctx.tree).items() if b.donate}
+    if not bindings:
+        return
+    for fnode, qual, _cls in ctx.functions:
+        calls = []
+        for node in ast.walk(fnode):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d in bindings:
+                    calls.append((node, d))
+        for call, d in calls:
+            stmt = _stmt_of(ctx, call)
+            rebound_now = _target_paths(stmt) if stmt is not None else set()
+            donated_args = []
+            for i in bindings[d].donate:
+                if i < len(call.args):
+                    p = dotted(call.args[i])
+                    if p and (p.count(".") == 0 or p.startswith("self.")):
+                        donated_args.append((p, call.args[i]))
+            for path, argnode in donated_args:
+                if path in rebound_now:
+                    continue
+                loads, stores = _path_events(fnode, path)
+                next_store = min((s.lineno for s in stores
+                                  if s.lineno > call.lineno), default=None)
+                bad = [l for l in loads
+                       if l.lineno > call.lineno
+                       and (next_store is None or l.lineno < next_store)
+                       and l is not argnode]
+                if bad:
+                    first = min(bad, key=lambda n: (n.lineno, n.col_offset))
+                    yield Finding(
+                        "DONATION", ctx.relpath, first.lineno,
+                        first.col_offset, qual,
+                        f"'{path}' read after being donated to {d}() at line "
+                        f"{call.lineno} — donated buffers are invalid; rebind "
+                        f"the result first")

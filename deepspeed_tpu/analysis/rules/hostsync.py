@@ -1,0 +1,105 @@
+"""HOSTSYNC: no implicit device->host transfers in hot-path code.
+
+Invariant guarded: the serving step and decode kernels never block on a
+device readback mid-step. ``int()/float()/bool()`` on an array-valued
+expression, ``.item()``/``.tolist()``, ``np.asarray()``/``np.array()``
+and ``jax.device_get`` all force a sync; inside a ``@hot_path`` function
+(or one named in the module allowlist) each is a finding.
+
+Second half: the kv_pool harvest helpers. ``harvest_snapshot`` is THE
+documented single batched transfer per step; ``max_active_frontier`` /
+``free_slots`` pay their own transfer when called without ``snap=``.
+Outside the sanctioned sites (engine step boundaries, kv_pool itself)
+those own-sync forms are findings anywhere in the tree — the fix is to
+thread an already-paid snapshot through ``snap=``.
+"""
+
+import ast
+
+from ..core import Finding, dotted
+
+_SYNC_ATTR_CALLS = {"item", "tolist"}
+_SYNC_DOTTED = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get", "jax.block_until_ready",
+}
+_CAST_NAMES = {"int", "float", "bool"}
+# kv_pool helpers that sync on their own when snap= is omitted.
+_SNAP_HELPERS = {"max_active_frontier", "free_slots"}
+_ALWAYS_SYNC_HELPERS = {"harvest_snapshot"}
+
+
+def _looks_arraylike(node: ast.AST) -> bool:
+    """Heuristic: a cast argument is array-valued if it indexes anything
+    other than ``.shape`` or calls anything other than ``len``. Bare
+    names, constants, and shape arithmetic (``x.shape[0]``, ``hd ** 0.5``)
+    stay castable — they are static Python scalars under trace."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Subscript):
+            base = sub.value
+            if isinstance(base, ast.Attribute) and base.attr == "shape":
+                continue
+            return True
+        if isinstance(sub, ast.Call):
+            if dotted(sub.func) == "len":
+                continue
+            return True
+    return False
+
+
+def _scan_hot_subtree(ctx, root, qual):
+    for node in ast.walk(root):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_ATTR_CALLS:
+            yield Finding(
+                "HOSTSYNC", ctx.relpath, node.lineno, node.col_offset, qual,
+                f".{node.func.attr}() forces a device->host sync in hot-path code")
+        elif d in _SYNC_DOTTED:
+            yield Finding(
+                "HOSTSYNC", ctx.relpath, node.lineno, node.col_offset, qual,
+                f"{d}() forces a device->host sync in hot-path code")
+        elif d in _CAST_NAMES and node.args and _looks_arraylike(node.args[0]):
+            yield Finding(
+                "HOSTSYNC", ctx.relpath, node.lineno, node.col_offset, qual,
+                f"{d}() on an array-valued expression blocks on device readback "
+                f"in hot-path code")
+
+
+def _sanctioned(ctx, node, config) -> bool:
+    allow = ctx.module_allowlist(config.sanctioned_sync_sites)
+    enc = ctx.enclosing_function(node)
+    if enc is None:
+        return False
+    _fnode, qual = enc
+    return qual in allow or qual.rsplit(".", 1)[-1] in allow
+
+
+def check(ctx, config):
+    for fnode, qual in ctx.hot_functions(config):
+        yield from _scan_hot_subtree(ctx, fnode, qual)
+
+    # Harvest-helper discipline applies to the whole module, hot or not.
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is None:
+            continue
+        name = d.rsplit(".", 1)[-1]
+        if name in _ALWAYS_SYNC_HELPERS and not _sanctioned(ctx, node, config):
+            enc = ctx.enclosing_function(node)
+            yield Finding(
+                "HOSTSYNC", ctx.relpath, node.lineno, node.col_offset,
+                enc[1] if enc else "",
+                f"{name}() outside a sanctioned snapshot point — reuse the "
+                f"step's snapshot instead of paying a fresh transfer")
+        elif name in _SNAP_HELPERS and not _sanctioned(ctx, node, config):
+            if not any(kw.arg == "snap" for kw in node.keywords):
+                enc = ctx.enclosing_function(node)
+                yield Finding(
+                    "HOSTSYNC", ctx.relpath, node.lineno, node.col_offset,
+                    enc[1] if enc else "",
+                    f"{name}() without snap= pays its own device->host "
+                    f"transfer — pass an existing harvest snapshot")

@@ -1,0 +1,133 @@
+"""RECOMPILE: call patterns that break the compile_count==1 contract.
+
+Invariant guarded: after warmup the serving engine never retraces. Three
+ways the tree has actually broken (or nearly broken) it:
+
+A. A ``static_argnums`` position receiving a freshly computed value
+   (``step(pool, len(batch))``): every distinct value is a new cache key
+   and a full retrace. Hoist it to a variable whose value is fixed after
+   warmup, or make the argument traced.
+B. A Python container literal (list/dict/set/comprehension) at a TRACED
+   position: the pytree is rebuilt per call, and any shape/length drift
+   retraces. Pass arrays or a fixed namedtuple.
+C. ``jax.jit`` over a closure that reads mutable config attributes
+   (``self.config.X`` or a closed-over ``*config``/``*cfg`` object):
+   the traced program bakes in the value at trace time, so a later
+   config change is silently ignored OR forces a manual cache flush —
+   the PR 8 uncommitted-pool class. Snapshot to a local first.
+"""
+
+import ast
+import re
+
+from ..core import Finding, dotted
+from ._jit import collect_bindings, parse_jit_call
+
+_CONFIG_NAME_RE = re.compile(r"(^|_)(config|cfg)$")
+_CONTAINERS = (ast.List, ast.Dict, ast.Set,
+               ast.ListComp, ast.DictComp, ast.SetComp, ast.GeneratorExp)
+
+
+def _bound_names(fn: ast.AST) -> set:
+    """Parameter names + names assigned anywhere inside ``fn``."""
+    bound = set()
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = fn.args
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+            bound.add(arg.arg)
+        if a.vararg:
+            bound.add(a.vararg.arg)
+        if a.kwarg:
+            bound.add(a.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+    return bound
+
+
+def _config_reads(fn: ast.AST):
+    """Attribute reads of mutable config state inside a traced closure."""
+    bound = _bound_names(fn)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Attribute) or not isinstance(node.ctx, ast.Load):
+                continue
+            d = dotted(node)
+            if d is None:
+                continue
+            parts = d.split(".")
+            if d.startswith("self.config."):
+                yield node, d
+            elif (len(parts) >= 2 and parts[0] not in bound
+                  and _CONFIG_NAME_RE.search(parts[0])):
+                yield node, d
+
+
+def _resolve_traced_fn(jit_call: ast.Call, ctx):
+    """The function object jax.jit wraps, when it is a closure we can see:
+    a lambda, or a Name bound to a def NESTED in the enclosing function
+    (module-level defs don't capture per-instance config). functools
+    .partial(f, ...) unwraps to f."""
+    if not jit_call.args:
+        return None
+    target = jit_call.args[0]
+    if isinstance(target, ast.Call) and (dotted(target.func) or "").endswith("partial"):
+        if not target.args:
+            return None
+        target = target.args[0]
+    if isinstance(target, ast.Lambda):
+        return target
+    if not isinstance(target, ast.Name):
+        return None
+    enc = ctx.enclosing_function(jit_call)
+    if enc is None:
+        return None
+    for node in ast.walk(enc[0]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == target.id:
+            return node
+    return None
+
+
+def check(ctx, config):
+    bindings = collect_bindings(ctx.tree)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+
+        # C: jit over a closure reading mutable config.
+        binding = parse_jit_call(node)
+        if binding is not None:
+            fn = _resolve_traced_fn(node, ctx)
+            if fn is not None:
+                enc = ctx.enclosing_function(node)
+                for _read, path in _config_reads(fn):
+                    yield Finding(
+                        "RECOMPILE", ctx.relpath, node.lineno, node.col_offset,
+                        enc[1] if enc else "",
+                        f"jitted closure reads mutable config attribute "
+                        f"'{path}' — value is baked in at trace time; "
+                        f"snapshot it to a local before tracing")
+            continue
+
+        d = dotted(node.func)
+        if d is None or d not in bindings:
+            continue
+        static = set(bindings[d].static)
+        enc = ctx.enclosing_function(node)
+        qual = enc[1] if enc else ""
+        for i, arg in enumerate(node.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if i in static and isinstance(arg, ast.Call):
+                yield Finding(
+                    "RECOMPILE", ctx.relpath, arg.lineno, arg.col_offset, qual,
+                    f"static_argnums position {i} of {d}() receives a freshly "
+                    f"computed value — every distinct value retraces; hoist it")
+            elif i not in static and isinstance(arg, _CONTAINERS):
+                yield Finding(
+                    "RECOMPILE", ctx.relpath, arg.lineno, arg.col_offset, qual,
+                    f"traced position {i} of {d}() receives a Python container "
+                    f"literal — pytree rebuilt per call, length drift retraces")

@@ -77,6 +77,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.analysis.annotations import hot_path
 from deepspeed_tpu.inference.config import InferenceConfig
 from deepspeed_tpu.inference.faults import FaultInjector
 from deepspeed_tpu.inference.resilience import (
@@ -132,6 +133,7 @@ def _neg():
     return _NEG
 
 
+@hot_path
 def _sample_rows(logits, temp, top_k, seed, position):
     """Per-row sampling over [R, V] fp32 logits with PER-ROW params (all
     traced — a new temperature/top_k mix never recompiles). temp<=0 is
@@ -213,6 +215,7 @@ class _CounterBank(object):
 # so per-engine compile counters (_cache_size) stay honest.
 
 
+@hot_path
 def _prefill_program(params, gcfg, pool, prompt, prompt_len, slot,
                      max_new, eos_id, temp, top_k, seed):
     """LEGACY path: admit one request into ``slot`` with a whole-prompt
@@ -236,6 +239,7 @@ def _prefill_program(params, gcfg, pool, prompt, prompt_len, slot,
     return pool, first
 
 
+@hot_path
 def _decode_chunk_program(params, gcfg, chunk, pool):
     """Advance every ACTIVE slot ``chunk`` tokens in one scan. Returns
     (pool', tokens [chunk, slots], valid [chunk, slots]) — valid[t, s]
@@ -268,6 +272,7 @@ def _decode_chunk_program(params, gcfg, chunk, pool):
     return pool, toks, valid
 
 
+@hot_path
 def _spec_decode_chunk_program(params, gcfg, chunk, spec_k, spec_ngram,
                                pool):
     """The decode lane with SPECULATION: ``chunk`` draft/verify steps in
@@ -342,6 +347,7 @@ def _spec_decode_chunk_program(params, gcfg, chunk, spec_k, spec_ngram,
     return pool, toks, valid
 
 
+@hot_path
 def _mixed_step_program(params, gcfg, chunk, spec, pool, p_ids, p_slot,
                         p_frontier, p_valid, p_done, p_spec, p_max_new,
                         p_eos, p_temp, p_top_k, p_seed):
@@ -434,6 +440,22 @@ class InferenceEngine(object):
     tensor-sharded serving.
     """
 
+    # graftlint THREADRACE manifest. The engine is single-threaded BY
+    # CONTRACT: every entry into it is externally serialized (the fleet
+    # wraps each engine call in ``rep.lock``; standalone use is one
+    # caller thread), so its mutable serving state is owned by whichever
+    # thread holds that outer lock — no internal ``self._lock`` exists
+    # to take. Declaring the set keeps the contract reviewable: a NEW
+    # attribute written outside __init__ must either join this manifest
+    # (same ownership argument) or take a lock.
+    _THREAD_OWNED = frozenset({
+        "_pool",            # device KV pool; stepper-owned, rebound per step
+        "_last_snap",       # last harvest snapshot (same owner as _pool)
+        "_injector",        # fault plan, swapped between steps
+        "_recovery_streak", "_last_swap_out_s",
+        "_accept_hist", "_accept_base", "_window_t0",
+    })
+
     def __init__(self, model, params, config=None, mesh=None):
         if config is None:
             config = InferenceConfig()
@@ -489,6 +511,10 @@ class InferenceEngine(object):
         hspec = spec_from_config(config)
         self._hier = None
         self._last_swap_out_s = None
+        # Most recent step harvest (host arrays). metrics() derives its
+        # frontier hint from this instead of paying a fresh device sync
+        # per scrape; None until the first step and across pool rebuilds.
+        self._last_snap = None
         if hspec.enabled:
             self._hier = KVHierarchy(
                 hspec, self._gcfg,
@@ -735,6 +761,7 @@ class InferenceEngine(object):
             time.sleep(self.config.recovery_backoff_s *
                        self._recovery_streak)
         self._pool = self._build_pool()
+        self._last_snap = None  # snapshot described the torn-down pool
         if self._hier is not None:
             # The trie/refcounts/swap records all described the pool
             # that just died (requeue_running pulls SWAPPED sessions
@@ -1107,6 +1134,7 @@ class InferenceEngine(object):
             toks = np.asarray(toks)
             valid = np.asarray(valid)
             snap = harvest_snapshot(self._pool)
+        self._last_snap = snap
         active = snap["active"]
         self.timers("inference/decode").stop()
         if self._injector is not None:
@@ -1194,7 +1222,9 @@ class InferenceEngine(object):
                     self._annotate("inference/harvest"):
                 toks = np.asarray(toks)
                 valid = np.asarray(valid)
-                active = harvest_snapshot(self._pool)["active"]
+                snap = harvest_snapshot(self._pool)
+            self._last_snap = snap
+            active = snap["active"]
             if self._injector is not None:
                 toks = self._injector.corrupt_harvest(toks, valid)
             self._check_harvest(toks, valid)
@@ -1360,7 +1390,13 @@ class InferenceEngine(object):
             "flash_decode": bool(self._gcfg.use_flash_decode),
             "chunked_prefill": bool(self.config.chunked_prefill),
             "prefill_chunk": self.config.prefill_chunk,
-            "max_active_frontier": max_active_frontier(self._pool),
+            # Derived from the LAST step's harvest: a scrape (often a
+            # foreign exporter thread) must never pay a device sync of
+            # its own. Stale-by-one-chunk is fine for an observability
+            # hint; 0 before the first step / right after a rebuild.
+            "max_active_frontier": (
+                max_active_frontier(self._pool, snap=self._last_snap)
+                if self._last_snap is not None else 0),
             "spec_decode": self._spec is not None,
             # Resilience: health is a state fact (never windowed); the
             # counters window like everything else.

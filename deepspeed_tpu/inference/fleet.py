@@ -284,6 +284,13 @@ class ServingFleet(object):
     — its windows are the SLO evidence ``rolling_drain`` checks before
     taking a replica out of rotation."""
 
+    # graftlint THREADRACE manifest — deliberately EMPTY: the fleet is
+    # the multi-threaded half of the stack (replica pump threads, the
+    # caller, watchdogs, __del__), so every shared attribute write
+    # outside __init__ must hold self._lock. Per-replica state lives on
+    # _Replica and is serialized by rep.lock instead.
+    _THREAD_OWNED = frozenset()
+
     def __init__(self, model, params, n_replicas=2, config=None, seed=0,
                  window_seconds=1.0, window_capacity=512, start=True,
                  breaker_factory=None, idle_wait_s=0.01, poll_s=0.002):
@@ -344,9 +351,13 @@ class ServingFleet(object):
 
     def start(self):
         """Launch the per-replica stepping threads (idempotent)."""
-        if self._started or self._closed:
-            return
-        self._started = True
+        # Check-and-set under the fleet lock: two racing start() calls
+        # (or a start() racing close()) must not both pass the guard and
+        # double-spawn replica threads.
+        with self._lock:
+            if self._started or self._closed:
+                return
+            self._started = True
         for rep in self.replicas:
             rep.thread = threading.Thread(
                 target=self._replica_loop, args=(rep,),
@@ -829,9 +840,14 @@ class ServingFleet(object):
         Idempotent; a closed fleet still reads (metrics, harvest) but
         never steps or submits again. __del__ calls this so interpreter
         exit never hangs on a fleet the test forgot."""
-        if self._closed:
-            return
-        self._closed = True
+        # Flag flip under the lock (close() is reachable from any thread
+        # via __del__ / GC); the joins below run OUTSIDE it — replica
+        # threads take self._lock in _pump, so holding it across join()
+        # would deadlock the drain.
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
         for rep in self.replicas:
             rep.stop.set()
             rep.wake.set()

@@ -89,6 +89,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.analysis.annotations import hot_path
 from deepspeed_tpu.ops.transformer.kernels import decode_attention
 from deepspeed_tpu.parallel import mesh as mesh_lib
 
@@ -210,6 +211,7 @@ def pool_nbytes(pool):
                    for leaf in jax.tree_util.tree_leaves(pool)))
 
 
+@hot_path
 def cache_view(pool):
     """The pool's k/v/pos as a ``models.generation`` cache dict — the
     decode step program consumes the pool's slots directly as batch rows.
@@ -235,6 +237,7 @@ def cache_view(pool):
     return cache
 
 
+@hot_path
 def slot_cache_view(pool, slot, pos):
     """ONE slot's k/v as a batch-1 cache dict for the prefill lane:
     plane slices (and scale slices when int8) along the slot axis, plus
@@ -263,6 +266,7 @@ def slot_cache_view(pool, slot, pos):
     return cache
 
 
+@hot_path
 def write_slot_cache(pool, slot, cache):
     """Fold a ``slot_cache_view`` batch-1 cache back into the pool.
     Only the slot's WRITABLE state returns: k/v (+ scales); the prefix
@@ -276,6 +280,7 @@ def write_slot_cache(pool, slot, cache):
     return pool
 
 
+@hot_path
 def fold_cache(pool, cache):
     """Fold a full-batch ``cache_view`` cache back into the pool after a
     decode/verify step: k/v planes and scale planes. The gathered

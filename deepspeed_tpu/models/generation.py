@@ -29,6 +29,7 @@ import os
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.analysis.annotations import hot_path
 from deepspeed_tpu.ops.transformer.kernels import decode_attention
 
 # Hashable shape/dtype subset of GPT2Config (the dataclass itself is
@@ -95,6 +96,7 @@ def _dense(x, p):
             p["bias"].astype(x.dtype))
 
 
+@hot_path
 def _forward(params, cfg, ids, cache, last_only=False):
     """ids [B, S], row b starting at cache['pos'][b]; returns
     (logits [B, S, V] fp32, updated cache). S=prompt_len for prefill, S=1
@@ -250,6 +252,7 @@ def _forward(params, cfg, ids, cache, last_only=False):
     return logits, out
 
 
+@hot_path
 def append_forward(params, cfg, ids, cache, n_valid=None):
     """Append ``ids`` [B, S] at each row's frontier ``cache['pos']`` —
     the chunked-prefill primitive: one prompt slice per call, causally
@@ -273,6 +276,7 @@ def append_forward(params, cfg, ids, cache, n_valid=None):
     return logits, cache
 
 
+@hot_path
 def decode_step(params, cfg, tok, cache):
     """Advance every row one token: feed ``tok`` [B] (the token sitting at
     each row's frontier ``cache['pos']``), write its k/v there, and return
@@ -284,6 +288,7 @@ def decode_step(params, cfg, tok, cache):
     return logits[:, 0], cache
 
 
+@hot_path
 def verify_forward(params, cfg, ids, cache):
     """Score ``ids`` [B, S] at each row's frontier WITHOUT advancing it —
     the speculative-decoding VERIFY primitive. Row b's ids are
@@ -305,6 +310,7 @@ def verify_forward(params, cfg, ids, cache):
     return logits, dict(cache, pos=pos0)
 
 
+@hot_path
 def ngram_draft(toks, pos, n, k):
     """Prompt-lookup drafting (n-gram self-speculation): for each row,
     find the MOST RECENT earlier occurrence of the row's trailing
@@ -341,6 +347,7 @@ def ngram_draft(toks, pos, n, k):
     return jax.vmap(per_row)(toks, pos.astype(jnp.int32)).astype(jnp.int32)
 
 
+@hot_path
 def accept_counts(draft, choices, ok=None):
     """Speculative ACCEPT rule: given per-row drafts [B, K] and the
     model's own choices [B, K+1] from a verify pass (choices[:, i] is

@@ -47,6 +47,7 @@ from jax.experimental.custom_partitioning import custom_partitioning
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.analysis.annotations import hot_path
 from deepspeed_tpu.ops.transformer.kernels.attention import (
     NEG_INF,
     _STATS_LANES,
@@ -110,6 +111,7 @@ def decode_signature(b, h, s, t_kv, d, dtype):
 _Q8_EPS = 1e-8
 
 
+@hot_path
 def quantize_kv(x):
     """Quantize ``[..., D]`` k/v rows to int8 with per-row symmetric
     scales. Returns ``(codes int8 [..., D], scale fp32 [...])`` where
@@ -122,6 +124,7 @@ def quantize_kv(x):
     return codes.astype(jnp.int8), scale
 
 
+@hot_path
 def dequantize_kv(codes, scale, dtype=jnp.float32):
     """Inverse of ``quantize_kv``: ``codes [..., D]`` int8 with per-row
     ``scale [...]`` back to ``dtype``."""
@@ -135,6 +138,7 @@ def dequantize_kv(codes, scale, dtype=jnp.float32):
 # softmax) so flag-off and fallback paths are the SAME math.
 # ---------------------------------------------------------------------------
 
+@hot_path
 def decode_attention_reference(q, k, v, pos, scale=None):
     """q: [B, H, S, D] query rows, row b starting at global position
     ``pos[b]`` (its k/v already written at ``pos[b] .. pos[b]+S-1``);
@@ -155,6 +159,7 @@ def decode_attention_reference(q, k, v, pos, scale=None):
     return jnp.einsum("bhqk,bhkd->bhqd", att, v, precision=prec)
 
 
+@hot_path
 def decode_attention_q8_reference(q, k, v, k_scale, v_scale, pos,
                                   scale=None):
     """int8-cache ground truth: dequantize the whole plane, then the
@@ -597,6 +602,7 @@ def _decode_q8_partitioned(scale, block_k):
 # Public entry point
 # ---------------------------------------------------------------------------
 
+@hot_path
 def flash_decode_attention(q, k, v, pos, scale=None, block_k=None):
     """Length-aware fused cache attention over a slotted KV plane.
 
@@ -626,6 +632,7 @@ def flash_decode_attention(q, k, v, pos, scale=None, block_k=None):
     return _flash_decode_pallas(q, k, v, pos, float(scale), int(bk))
 
 
+@hot_path
 def flash_decode_attention_q8(q, k, v, k_scale, v_scale, pos, scale=None,
                               block_k=None):
     """int8-cache flash decode: same contract as ``flash_decode_attention``

@@ -1,0 +1,17 @@
+"""graftlint fixture: every call here is a DETERMINISM violation."""
+
+import random
+import time
+
+import numpy as np
+
+from deepspeed_tpu.analysis.annotations import hot_path
+
+
+@hot_path
+def sample_rows(logits):
+    seed = time.time()              # wall clock in replayable code
+    pick = random.randint(0, 10)    # process-global RNG
+    noise = np.random.rand(4)       # numpy global RNG
+    rng = np.random.default_rng()   # generator without an explicit seed
+    return seed, pick, noise, rng

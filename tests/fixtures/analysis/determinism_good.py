@@ -1,0 +1,16 @@
+"""graftlint fixture: the DETERMINISM-clean twin of determinism_bad.py."""
+
+import numpy as np
+
+from deepspeed_tpu.analysis.annotations import hot_path
+
+
+@hot_path
+def sample_rows(logits, seed, position):
+    rng = np.random.default_rng(seed)        # explicit seed: replayable
+    legacy = np.random.RandomState(seed)     # explicit seed: replayable
+    return rng, legacy, position
+
+
+def pace(clock):
+    return clock()  # injected clock: the caller owns determinism

@@ -1,0 +1,11 @@
+"""graftlint fixture: a DONATION violation — the PR 8 bug class."""
+
+import jax
+
+step = jax.jit(lambda pool: pool, donate_argnums=(0,))
+
+
+def advance(pool):
+    out = step(pool)         # pool's buffers are donated here
+    frontier = pool["pos"]   # ...so this reads a dead array
+    return out, frontier

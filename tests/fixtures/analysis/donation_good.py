@@ -1,0 +1,17 @@
+"""graftlint fixture: the DONATION-clean twin of donation_bad.py."""
+
+import jax
+
+step = jax.jit(lambda pool: pool, donate_argnums=(0,))
+
+
+def advance(pool):
+    pool = step(pool)        # rebound by the donating statement itself
+    frontier = pool["pos"]   # reads the NEW pool
+    return pool, frontier
+
+
+def advance_twice(pool):
+    new_pool = step(pool)    # old name never read again before rebind
+    pool = new_pool
+    return step(pool)

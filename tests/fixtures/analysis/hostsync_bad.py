@@ -1,0 +1,25 @@
+"""graftlint fixture: every line flagged here is a HOSTSYNC violation.
+
+Never imported — parsed by the analyzer only.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.analysis.annotations import hot_path
+
+
+@hot_path
+def decode_step(logits, cache):
+    first = int(logits[0])           # cast on an indexed array
+    frac = float(logits.mean())      # cast on a device computation
+    flag = bool(cache["active"][0])  # cast on an indexed plane
+    host = logits.tolist()           # explicit readback
+    arr = np.asarray(cache["k"])     # device->host copy
+    return first, frac, flag, host, arr
+
+
+def metrics(pool):
+    # Own-sync harvest helpers outside a sanctioned snapshot point.
+    snap = harvest_snapshot(pool)  # noqa: F821 — AST fixture, never run
+    depth = max_active_frontier(pool)  # noqa: F821
+    return snap, depth

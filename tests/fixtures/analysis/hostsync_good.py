@@ -1,0 +1,22 @@
+"""graftlint fixture: the HOSTSYNC-clean twin of hostsync_bad.py."""
+
+from deepspeed_tpu.analysis.annotations import hot_path
+
+
+@hot_path
+def decode_step(logits, cache, scale):
+    d = logits.shape[-1]
+    s = float(scale) / float(d) ** 0.5  # bare names: static scalars
+    n = int(logits.shape[0])            # shape access never syncs
+    m = int(len(cache))                 # len() is host-side metadata
+    return s * n * m
+
+
+def metrics(pool, snap):
+    # Reuses an already-paid snapshot: no fresh transfer.
+    return max_active_frontier(pool, snap=snap)  # noqa: F821
+
+
+def host_side_harvest(arrays):
+    # Not hot-path: host code may read back freely.
+    return [int(a[0]) for a in arrays]

@@ -1,0 +1,20 @@
+"""graftlint fixture: every construct here is a RECOMPILE violation."""
+
+import jax
+
+step = jax.jit(lambda pool, k: pool, static_argnums=(1,))
+
+
+def serve(pool, batch):
+    pool = step(pool, len(batch))  # fresh value at a static position
+    pool = step([1, 2, 3], 0)      # container literal at a traced position
+    return pool
+
+
+class Engine:
+    def build(self):
+        def inner(x):
+            return x * self.config.scale  # closure over mutable config
+
+        self._fn = jax.jit(inner)
+        return self._fn

@@ -1,0 +1,23 @@
+"""graftlint fixture: the RECOMPILE-clean twin of recompile_bad.py."""
+
+import jax
+
+step = jax.jit(lambda pool, k: pool, static_argnums=(1,))
+
+
+def serve(pool, batch, ids):
+    k = len(batch)        # hoisted: fixed after warmup
+    pool = step(pool, k)  # name at the static position
+    pool = step(ids, 0)   # array at the traced position
+    return pool
+
+
+class Engine:
+    def build(self):
+        scale = self.config.scale  # snapshot BEFORE tracing
+
+        def inner(x):
+            return x * scale
+
+        self._fn = jax.jit(inner)
+        return self._fn

@@ -1,0 +1,20 @@
+"""graftlint fixture: one THREADRACE violation in a checked class."""
+
+import threading
+
+
+class FleetLike:
+    _THREAD_OWNED = frozenset({"_scratch"})
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._requests = {}
+        self._closed = False
+
+    def close(self):
+        self._closed = True  # shared flag written without the lock
+
+    def note(self, x):
+        self._scratch = x  # declared thread-owned: fine
+        with self._lock:
+            self._requests = {}  # under the lock: fine

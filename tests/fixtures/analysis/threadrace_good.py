@@ -1,0 +1,21 @@
+"""graftlint fixture: the THREADRACE-clean twin of threadrace_bad.py."""
+
+import threading
+
+
+class FleetLike:
+    _THREAD_OWNED = frozenset({"_scratch"})
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._requests = {}
+        self._closed = False
+
+    def close(self):
+        with self._lock:
+            self._closed = True  # flag flip under the lock
+
+    def note(self, x):
+        self._scratch = x  # declared thread-owned
+        with self._lock:
+            self._requests = {}
