@@ -1376,7 +1376,7 @@ def main_chaos(smoke=False):
     return 0
 
 
-def _measure_fleet(smoke=False):
+def _measure_fleet(smoke=False, prefix_affinity=True):
     """`bench.py --fleet-smoke`: the FLEET failover invariant as a
     benchmark artifact.
 
@@ -1388,7 +1388,14 @@ def _measure_fleet(smoke=False):
     budgets. The artifact build ASSERTS the invariant: zero requests
     lost, every stream bit-identical to a fault-free single-engine
     reference, the survivor's compile_count unchanged, and the fleet
-    healthy at exit — then stamps the facts machine-readable."""
+    healthy at exit — then stamps the facts machine-readable.
+
+    The stream is template-heavy (a small shared-prefix pool ahead of
+    unique tails) and the replicas run the prefix cache, so the
+    artifact also stamps the FLEET prefix hit rate; ``--no-prefix-
+    affinity`` (suffix ``_noprefixaffinity``) is the directory-off side
+    of that A/B — same stream, same caches, no fleet-level affinity or
+    adoption."""
     import jax
     import jax.numpy as jnp
 
@@ -1408,15 +1415,19 @@ def _measure_fleet(smoke=False):
         serve_cfg = {"max_slots": 8, "max_len": 512, "chunk_size": 8,
                      "prefill_chunk": 16, "max_queue": 64,
                      "spec_decode": True, "spec_k": 2, "spec_ngram": 2,
-                     "fault_injection": True, "recovery_max_retries": 0}
-        n_requests, max_new = 24, 48
+                     "fault_injection": True, "recovery_max_retries": 0,
+                     "prefix_cache": True, "prefix_slots": 8,
+                     "prefix_len": 64, "min_prefix_len": 8}
+        n_requests, max_new, template_len = 24, 48, 24
     else:
         cfg = GPT2Config.tiny(dropout=0.0, use_flash_attention=False)
         serve_cfg = {"max_slots": 2, "max_len": 64, "chunk_size": 2,
                      "prefill_chunk": 4, "max_queue": 32,
                      "spec_decode": True, "spec_k": 2, "spec_ngram": 2,
-                     "fault_injection": True, "recovery_max_retries": 0}
-        n_requests, max_new = 8, 8
+                     "fault_injection": True, "recovery_max_retries": 0,
+                     "prefix_cache": True, "prefix_slots": 4,
+                     "prefix_len": 16, "min_prefix_len": 4}
+        n_requests, max_new, template_len = 8, 8, 8
 
     model = GPT2LMHeadModel(cfg)
     rng = np.random.RandomState(0)
@@ -1426,10 +1437,17 @@ def _measure_fleet(smoke=False):
 
     # The fixed request stream: greedy and sampled interleaved, a third
     # of them opting out of speculation — the full mixed-batch surface.
+    # Template-heavy shape: two shared prompt templates ahead of short
+    # unique tails, so the prefix cache (and, fleet-side, the prefix
+    # directory + affinity routing) has real reuse to exploit.
     req_rng = np.random.RandomState(11)
+    templates = req_rng.randint(0, cfg.vocab_size,
+                                size=(2, template_len))
     requests = [
-        {"prompt": req_rng.randint(0, cfg.vocab_size,
-                                   size=4 + (i % 5)).astype(np.int32),
+        {"prompt": np.concatenate(
+            [templates[i % 2],
+             req_rng.randint(0, cfg.vocab_size,
+                             size=4 + (i % 5))]).astype(np.int32),
          "max_new_tokens": max_new,
          "temperature": 0.0 if i % 2 == 0 else 0.7,
          "seed": 1000 + i,
@@ -1457,7 +1475,8 @@ def _measure_fleet(smoke=False):
 
     fleet = ServingFleet(model, params, n_replicas=2,
                          config=InferenceConfig.from_dict(serve_cfg),
-                         window_seconds=0.1, seed=0)
+                         window_seconds=0.1, seed=0,
+                         prefix_affinity=prefix_affinity)
     t0 = time.time()
     wave1 = submit_all(fleet, requests[:n_requests // 2])
 
@@ -1495,6 +1514,7 @@ def _measure_fleet(smoke=False):
                   if g != r]
     dead = [rep.rid for rep in fleet.replicas if not rep.alive]
     fleet_metrics = fleet.metrics()["fleet"]
+    prefix_hit_rate = fleet.prefix_hit_rate()
     compile_counts = fleet.compile_counts
     health = fleet.health
     fleet.close()
@@ -1514,9 +1534,14 @@ def _measure_fleet(smoke=False):
     assert health == "healthy", "fleet unhealthy at exit: {}".format(
         health)
 
+    name = "gpt2_{}_fleet_failover_wall_s".format(
+        "355m" if on_tpu else "tiny_smoke")
+    if not prefix_affinity:
+        # A/B runs must not share last-good bookkeeping with the
+        # affinity-on series.
+        name += "_noprefixaffinity"
     return {
-        "metric": "gpt2_{}_fleet_failover_wall_s".format(
-            "355m" if on_tpu else "tiny_smoke"),
+        "metric": name,
         "value": round(wall_s, 6),
         "unit": "s",
         "vs_baseline": None,
@@ -1526,6 +1551,17 @@ def _measure_fleet(smoke=False):
             "n_requests": n_requests,
             "requests_lost": lost,
             "bit_identical": not mismatched,
+            "prefix_affinity": bool(prefix_affinity),
+            "fleet_prefix_hit_rate": round(prefix_hit_rate, 4),
+            "prefix_hits": int(fleet_metrics.get("prefix_hits", 0)),
+            "prefix_misses": int(fleet_metrics.get("prefix_misses", 0)),
+            "prefix_adoptions": int(
+                fleet_metrics.get("prefix_adoptions", 0)),
+            "prefix_bytes_shipped": int(
+                fleet_metrics.get("prefix_bytes_shipped", 0)),
+            "affinity_routed": int(
+                fleet_metrics.get("affinity_routed", 0)),
+            "prefix_directory": fleet_metrics.get("prefix_directory"),
             "failovers": fleet_metrics["failovers"],
             "dead_replicas": dead,
             "mid_stream_at_kill": mid_stream,
@@ -1540,10 +1576,10 @@ def _measure_fleet(smoke=False):
     }
 
 
-def main_fleet(smoke=False):
+def main_fleet(smoke=False, prefix_affinity=True):
     if not smoke:
         _require_tpu_or_exit()
-    _emit(_measure_fleet(smoke=smoke))
+    _emit(_measure_fleet(smoke=smoke, prefix_affinity=prefix_affinity))
     return 0
 
 
@@ -1592,16 +1628,22 @@ def _dispatch(argv):
     # hierarchy-off sides of the KV-memory-hierarchy A/Bs (default True
     # each; metric suffixed _noint8kv / _noprefixcache / _nohostoffload
     # so the series never mix).
+    # --no-prefix-affinity: the directory-off side of the fleet
+    # prefix-affinity A/B (--fleet/--fleet-smoke only; metric suffixed
+    # _noprefixaffinity) — per-replica caches stay on, fleet routing
+    # ignores them.
     flash_decode = False if "--no-flash-decode" in argv else None
     chunked = "--no-chunked-prefill" not in argv
     spec = "--no-spec-decode" not in argv
     int8_kv = "--no-int8-kv" not in argv
     prefix_cache = "--no-prefix-cache" not in argv
     host_offload = "--no-host-offload" not in argv
+    prefix_affinity = "--no-prefix-affinity" not in argv
     if "--fleet-smoke" in argv:
-        return main_fleet(smoke=True)
+        return main_fleet(smoke=True, prefix_affinity=prefix_affinity)
     if "--fleet" in argv:
-        return main_fleet(smoke="--smoke" in argv)
+        return main_fleet(smoke="--smoke" in argv,
+                          prefix_affinity=prefix_affinity)
     if "--chaos-smoke" in argv:
         return main_chaos(smoke=True)
     if "--chaos" in argv:

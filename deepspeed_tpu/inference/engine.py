@@ -90,7 +90,11 @@ from deepspeed_tpu.inference.resilience import (
 )
 from deepspeed_tpu.inference.kv_hierarchy import (
     KVHierarchy,
+    capture_prefix_row,
     capture_slot,
+    pick_swap_victim,
+    record_nbytes,
+    restore_prefix_row,
     restore_slot,
     spec_from_config,
 )
@@ -576,7 +580,14 @@ class InferenceEngine(object):
             # KV-hierarchy counters (docs/OBSERVABILITY.md) — zero
             # forever on a flat-pool engine.
             "prefix_hits", "prefix_misses", "prefix_inserts",
-            "prefix_evictions", "swap_outs", "swap_ins"))
+            "prefix_evictions", "swap_outs", "swap_ins",
+            # Fleet-prefix counters (docs/INFERENCE.md): planes adopted
+            # from peer replicas, host bytes those shipments moved, and
+            # requests the fleet routed here FOR a cached prefix. The
+            # fleet increments the latter; a standalone engine keeps
+            # them at zero.
+            "prefix_adoptions", "prefix_bytes_shipped",
+            "affinity_routed"))
         if self._hier is not None:
             # The hierarchy increments hits/misses/inserts itself; hand
             # it the bank so those land in the same registry counters.
@@ -1044,16 +1055,13 @@ class InferenceEngine(object):
         return resumed
 
     def _pick_swap_victim(self, exclude):
-        """The decoding session that can best afford to wait: largest
-        remaining budget (most decode steps left to amortize the swap),
-        oldest rid on ties. Sessions resumed THIS round are excluded —
-        no same-step thrash."""
+        """The decoding session that can best afford to wait — remaining
+        budget blended with last-touch age (kv_hierarchy.offload.
+        pick_swap_victim owns the policy). Sessions resumed THIS round
+        are excluded — no same-step thrash."""
         cands = [r for r in self._scheduler.running.values()
                  if r.phase == "decoding" and r.rid not in exclude]
-        if not cands:
-            return None
-        return max(cands,
-                   key=lambda r: (r.max_new_tokens - len(r.tokens), -r.rid))
+        return pick_swap_victim(cands)
 
     def _maybe_swap_out(self, resumed):
         """Swap-out policy: under slot pressure (queued work, no free
@@ -1085,6 +1093,51 @@ class InferenceEngine(object):
         self._swap_out_hist.observe(self._last_swap_out_s)
         if self._scheduler.queue:
             self._admit()
+
+    # ------------------------------------------- cross-replica adoption
+
+    def export_prefix(self, tokens):
+        """Capture this engine's cached planes for ``tokens`` (or its
+        longest stored prefix) to host memory — the DONOR half of
+        cross-replica plane adoption (inference/fleet.py). Returns
+        ``(matched_tokens, record)`` or None when the store holds no
+        usable span. The record carries int8 codes + scales exactly as
+        stored (dequantize-free shipping). Caller must hold this
+        engine's serialization lock, like every engine entry point."""
+        if self._hier is None or self._hier.store is None:
+            return None
+        toks = [int(t) for t in tokens]
+        row, depth = self._hier.store.lookup(toks)
+        if row is None or depth < self._hier.spec.min_prefix_len:
+            return None
+        return tuple(toks[:depth]), capture_prefix_row(
+            self._pool, row, depth)
+
+    def adopt_prefix(self, tokens, record):
+        """Write a peer replica's captured prefix planes into a local
+        prefix row and index it — the ACCEPTOR half of adoption. The
+        next admission's trie probe hits exactly as if this engine had
+        prefilled ``tokens`` itself; the planes are read-only aliased
+        thereafter (identical bytes -> identical attention -> the
+        bit-identity contract is untouched). Returns True on adoption;
+        False when the store already covers the span or every row is
+        pinned by live aliasers."""
+        if self._hier is None or self._hier.store is None:
+            return False
+        toks = tuple(int(t) for t in tokens)
+        _, depth = self._hier.store.lookup(list(toks))
+        if depth >= len(toks):
+            return False  # already holds at least this span
+        before = self._hier.store.evictions
+        row = self._hier.store.insert(toks)
+        self.counters["prefix_evictions"] += (
+            self._hier.store.evictions - before)
+        if row is None:
+            return False  # every row pinned by live aliasers
+        self._pool = restore_prefix_row(self._pool, row, record)
+        self.counters["prefix_adoptions"] += 1
+        self.counters["prefix_bytes_shipped"] += record_nbytes(record)
+        return True
 
     def _step_chunked(self):
         done = []
@@ -1179,6 +1232,7 @@ class InferenceEngine(object):
                     self._pool = self._hier.on_prefill_done(self._pool, pf)
                 self._harvest_first(pf, int(first), done)
 
+        harvest_t = time.time()
         for slot, req in list(self._scheduler.running.items()):
             if req.phase != "decoding":
                 continue  # mid-prefill slots emit nothing
@@ -1187,6 +1241,11 @@ class InferenceEngine(object):
             emitted = toks[:, slot][valid[:, slot]].tolist()
             req.tokens.extend(emitted)
             self.counters["tokens_out"] += len(emitted)
+            if emitted:
+                # Progress stamp the idle-aware swap-victim policy
+                # reads: a session that stops emitting goes stale here
+                # and becomes the preferred victim.
+                req.last_touch = harvest_t
             if not active[slot]:
                 self._complete(req, done)
         self._observe_compiles()
@@ -1454,6 +1513,12 @@ class InferenceEngine(object):
                 "swap_outs": c.window("swap_outs"),
                 "swap_ins": c.window("swap_ins"),
                 "slots_swapped": len(self._scheduler.swapped),
+                # Fleet-prefix view (zero outside a fleet): adoption
+                # traffic this engine accepted and the requests routed
+                # here for a prefix it already held.
+                "prefix_adoptions": c.window("prefix_adoptions"),
+                "prefix_bytes_shipped": c.window("prefix_bytes_shipped"),
+                "affinity_routed": c.window("affinity_routed"),
             })
         m.update(self._latency_percentiles())
         if reset:

@@ -30,6 +30,23 @@ sheds, watchdog ``step_stalls``, and fatal-step recoveries. The fleet
 consults ``breaker.allow()`` only for replicas it actually attempts, so
 half-open probes are never burned on untried candidates.
 
+Prefix affinity (this PR): when the replicas run PR 9's prefix cache,
+the fleet keeps a ``PrefixDirectory`` — a host-side map of published
+prefix rows per replica, re-synced after clean steps (gated by the
+store's ``version`` counter) and invalidated wholesale on replica
+death or recovery. ``submit()`` folds the directory's longest-match
+depth into the router score (``score - AFFINITY_WEIGHT * depth /
+prefix_len``), so template traffic lands on the replica already
+holding its prefix planes; when load wins the route anyway, the cold
+winner ADOPTS the holder's planes (``export_prefix`` on the donor,
+``adopt_prefix`` on the acceptor — int8 codes ship as-is, no
+dequantize round-trip) before submitting, so the prefill skips the
+shared span either way. The directory is derived state and never
+authoritative: both adoption ends re-validate against their live
+PrefixStore under their own replica lock, and the failover/recovery
+invariants never depend on it (``prefix_affinity=False`` disables the
+whole plane for a clean A/B).
+
 Locking discipline (the whole concurrency story, in one place):
 
 - ``rep.lock`` (one per replica) serializes EVERY call into that
@@ -64,6 +81,7 @@ import numpy as np
 
 from deepspeed_tpu.inference.config import InferenceConfig
 from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.kv_hierarchy import PrefixDirectory
 from deepspeed_tpu.inference.resilience import (
     EngineDeadError,
     EngineDraining,
@@ -193,7 +211,8 @@ class _Replica(object):
 
     __slots__ = ("rid", "engine", "device", "breaker", "lock", "wake",
                  "stop", "thread", "failed", "last_stalls",
-                 "last_recoveries", "_g_queue", "_g_occ")
+                 "last_recoveries", "last_prefix_version", "_g_queue",
+                 "_g_occ")
 
     def __init__(self, rid, engine, device, breaker):
         self.rid = rid
@@ -207,6 +226,10 @@ class _Replica(object):
         self.failed = False
         self.last_stalls = 0
         self.last_recoveries = 0
+        # PrefixStore.version at the last directory sync — gates the
+        # publish walk so clean steps with an unchanged prefix set pay
+        # one int compare, not a store scan.
+        self.last_prefix_version = -1
         self._g_queue = engine.telemetry.gauge("queue_depth")
         self._g_occ = engine.telemetry.gauge("slot_occupancy")
 
@@ -293,7 +316,8 @@ class ServingFleet(object):
 
     def __init__(self, model, params, n_replicas=2, config=None, seed=0,
                  window_seconds=1.0, window_capacity=512, start=True,
-                 breaker_factory=None, idle_wait_s=0.01, poll_s=0.002):
+                 breaker_factory=None, idle_wait_s=0.01, poll_s=0.002,
+                 prefix_affinity=None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1, got "
                              "{}".format(n_replicas))
@@ -327,6 +351,15 @@ class ServingFleet(object):
             self.replicas.append(
                 _Replica(i, eng, devices[i], breaker_factory()))
         self.router = Router(seed=seed)
+        # Fleet-global prefix directory: on by default whenever the
+        # replicas run a prefix cache (there is nothing to publish
+        # without one); prefix_affinity=False forces it off for a clean
+        # affinity-free A/B on the same config.
+        if prefix_affinity is None:
+            prefix_affinity = bool(config.prefix_cache)
+        self.prefix_affinity = bool(prefix_affinity)
+        self._directory = PrefixDirectory() if self.prefix_affinity \
+            else None
         self.telemetry = MergedRegistry(
             {r.rid: r.engine.telemetry for r in self.replicas})
         self.collector = TimeseriesCollector(
@@ -403,6 +436,7 @@ class ServingFleet(object):
                     pass
             else:
                 self._observe_resilience(rep)
+                self._sync_prefixes(rep)
         if dead is not None:
             self._failover(rep, dead)
             return False
@@ -418,8 +452,32 @@ class ServingFleet(object):
         recoveries = c["recoveries"]
         if stalls > rep.last_stalls or recoveries > rep.last_recoveries:
             rep.breaker.trip()
+        if recoveries > rep.last_recoveries and \
+                self._directory is not None:
+            # A recovery rebuilt the pool (KVHierarchy.reset) — every
+            # plane the directory described for this replica is gone.
+            # Drop them wholesale; the store's bumped version re-syncs
+            # whatever the replay re-earns. Directory lock is a leaf,
+            # safe under rep.lock.
+            self._directory.invalidate(rep.rid)
         rep.last_stalls = stalls
         rep.last_recoveries = recoveries
+
+    def _sync_prefixes(self, rep):
+        """Publish this replica's live prefix rows into the directory
+        (called under rep.lock, right after a clean step). The store's
+        ``version`` counter — bumped only when row CONTENTS change —
+        gates the walk, so the steady state costs one int compare."""
+        if self._directory is None:
+            return
+        hier = rep.engine._hier
+        if hier is None or hier.store is None:
+            return
+        version = hier.store.version
+        if version == rep.last_prefix_version:
+            return
+        self._directory.sync(rep.rid, hier.store.tokens.values())
+        rep.last_prefix_version = version
 
     def _tick(self):
         # Non-blocking: whichever thread hits the window boundary first
@@ -432,29 +490,109 @@ class ServingFleet(object):
 
     # ------------------------------------------------------------- submit
 
-    def _ordered(self, include_draining=False):
+    def _ordered(self, include_draining=False, match=None):
         views = [rep for rep in self.replicas
                  if rep.alive and (rep.engine.health in
                                    ("healthy", "degraded")
                                    or include_draining)]
-        return self.router.order(views)
+        if not match:
+            return self.router.order(views)
+        # Prefix affinity: matched depth over the prefix plane length,
+        # zeroed below min_prefix_len (the acceptor's on_admit probe
+        # would not alias a shorter span anyway). Scoring happens in
+        # the router (score - AFFINITY_WEIGHT * affinity); dead stays
+        # inf and breakers are still consulted per attempted candidate.
+        plen = float(max(self.config.prefix_len, 1))
+        minp = self.config.min_prefix_len
+        affinity = []
+        for rep in views:
+            d = match.get(rep.rid, 0)
+            affinity.append(min(d, plen) / plen if d >= minp else 0.0)
+        return self.router.order(views, affinity)
+
+    def _match_prefix(self, prompt):
+        """Directory longest-match for one prompt: {replica_id: depth},
+        or {} when affinity is off / the prompt is malformed (admission
+        validation in engine.submit is the authority on that)."""
+        if self._directory is None:
+            return {}
+        try:
+            toks = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        except (TypeError, ValueError):
+            return {}
+        if not toks:
+            return {}
+        return self._directory.match(toks)
+
+    def _maybe_adopt(self, rep, prompt, match):
+        """Cross-replica plane adoption: the routed-to replica does not
+        hold the prompt's best published prefix, so ship the planes
+        from a holder instead of recomputing the prefill. Returns True
+        when ``rep`` now holds a usable prefix.
+
+        Locking: the donor's rep.lock and the acceptor's rep.lock are
+        taken SEQUENTIALLY, never nested — two submits adopting in
+        opposite directions must not deadlock. Both sides re-validate
+        against their LIVE PrefixStore under their own lock (the
+        directory is derived state; export_prefix returns None when the
+        donor's row was evicted since publish, adopt_prefix refuses
+        when the acceptor already covers the span)."""
+        minp = self.config.min_prefix_len
+        own = match.get(rep.rid, 0)
+        best, donors = 0, []
+        for rid, d in match.items():
+            if rid == rep.rid:
+                continue
+            peer = self.replicas[rid]
+            if not peer.alive:
+                continue
+            if d > best:
+                best, donors = d, [peer]
+            elif d == best and d > 0:
+                donors.append(peer)
+        if best < minp or best <= own:
+            return own >= minp
+        toks = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        exported = None
+        for donor in sorted(donors, key=lambda r: r.rid):
+            with donor.lock:
+                if donor.failed:
+                    continue
+                exported = donor.engine.export_prefix(toks[:best])
+            if exported is not None:
+                break
+        if exported is None:
+            return own >= minp
+        matched, record = exported
+        with rep.lock:
+            if rep.failed:
+                return False
+            ok = rep.engine.adopt_prefix(matched, record)
+        if ok:
+            self._directory.add(rep.rid, matched)
+        return ok or own >= minp
 
     def submit(self, prompt, **kw):
         """Route one request to the best live replica; returns a
-        FleetRequest. Tries replicas in router order, consulting each
-        breaker only at its attempt (allow() in open state IS the
-        half-open probe — never burned on an untried candidate). Raises
-        the fleet-level analogue of the engine's admission errors:
-        QueueFull (structured: summed queue_depth, MIN retry_after
-        across shed hints and open breakers, replica_id=None) when
-        every candidate rejected; EngineDraining when every live
-        replica has admissions closed; EngineDeadError when the whole
-        fleet is dead."""
+        FleetRequest. Tries replicas in router order — prefix affinity
+        folded into the score when the fleet runs a prefix directory —
+        consulting each breaker only at its attempt (allow() in open
+        state IS the half-open probe — never burned on an untried
+        candidate). A winning candidate that lacks the prompt's best
+        published prefix adopts the holder's planes first
+        (_maybe_adopt), so even cold replicas serve template traffic
+        without re-prefilling it. Raises the fleet-level analogue of
+        the engine's admission errors: QueueFull (structured: summed
+        queue_depth, MIN retry_after across shed hints and open
+        breakers, replica_id=None) when every candidate rejected;
+        EngineDraining when every live replica has admissions closed;
+        EngineDeadError when the whole fleet is dead."""
         if self._closed:
             raise RuntimeError("submit() on a closed fleet")
         if self._orphans:
             self._pump()
-        candidates = self._ordered()
+        match = self._match_prefix(prompt)
+        candidates = self._ordered(match=match)
         if not candidates:
             if any(rep.alive for rep in self.replicas):
                 raise EngineDraining(
@@ -467,6 +605,7 @@ class ServingFleet(object):
             if not rep.breaker.allow():
                 hints.append(rep.breaker.retry_after_s())
                 continue
+            affine = bool(match) and self._maybe_adopt(rep, prompt, match)
             with rep.lock:
                 if rep.failed:
                     continue
@@ -481,6 +620,8 @@ class ServingFleet(object):
                 except (EngineDraining, EngineDeadError):
                     continue
                 rep.breaker.record_success()
+                if affine:
+                    rep.engine.counters["affinity_routed"] += 1
                 with self._lock:
                     fr = FleetRequest(next(self._fids), rep.rid, req)
                     self._requests[fr.fid] = fr
@@ -559,6 +700,11 @@ class ServingFleet(object):
                     fr._orphan()
                 self._orphans.extend(moved)
                 self.failovers += len(moved)
+                if self._directory is not None:
+                    # The dead pool's planes are gone — no adoption or
+                    # affinity may ever point at them again. (Leaf
+                    # lock: safe under rep.lock + self._lock.)
+                    self._directory.invalidate(rep.rid)
         logger.warning(
             "fleet: replica %d is dead (%s: %s) — failing over %d live "
             "request(s) to survivors", rep.rid, type(exc).__name__, exc,
@@ -805,7 +951,9 @@ class ServingFleet(object):
         agg = {}
         for name in ("tokens_out", "requests_completed", "recoveries",
                      "requests_replayed", "deadline_sheds", "step_stalls",
-                     "faults_injected"):
+                     "faults_injected", "prefix_hits", "prefix_misses",
+                     "prefix_adoptions", "prefix_bytes_shipped",
+                     "affinity_routed"):
             if name in self.counters:
                 agg[name] = self.counters[name]
         agg.update({
@@ -817,7 +965,19 @@ class ServingFleet(object):
             "breaker_states": {rep.rid: rep.breaker.state
                                for rep in self.replicas},
         })
+        if self._directory is not None:
+            agg["prefix_directory"] = self._directory.snapshot()
+            agg["prefix_hit_rate"] = self.prefix_hit_rate()
         return {"fleet": agg, "replicas": per_replica}
+
+    def prefix_hit_rate(self):
+        """Fleet-wide prefix hit rate (hits / probes, 0.0 when no
+        probes) — the bench A/B's headline number."""
+        c = self.counters
+        hits = c["prefix_hits"] if "prefix_hits" in c else 0
+        misses = c["prefix_misses"] if "prefix_misses" in c else 0
+        total = hits + misses
+        return hits / total if total else 0.0
 
     def prometheus(self):
         """One text-exposition snapshot of the WHOLE fleet: the merged

@@ -38,12 +38,19 @@ from deepspeed_tpu.inference.kv_hierarchy.hierarchy import (  # noqa: F401
 )
 from deepspeed_tpu.inference.kv_hierarchy.offload import (  # noqa: F401
     HostSwapStore,
+    capture_prefix_row,
     capture_slot,
+    pick_swap_victim,
+    record_nbytes,
+    restore_prefix_row,
     restore_slot,
 )
 from deepspeed_tpu.inference.kv_hierarchy.prefix_cache import (  # noqa: F401
     PrefixStore,
     RadixTrie,
+)
+from deepspeed_tpu.inference.kv_hierarchy.prefix_directory import (  # noqa: F401,E501
+    PrefixDirectory,
 )
 from deepspeed_tpu.inference.kv_hierarchy.quant import (  # noqa: F401
     dequantize_kv,

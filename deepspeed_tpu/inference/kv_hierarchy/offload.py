@@ -16,13 +16,31 @@ captured, because every positional fact (pos, toks ring, prefix base)
 travels inside the record. The restored plane is bit-identical to the
 captured one, so the resumed greedy stream continues exactly where it
 paused.
+
+The same fixed-shape transport carries PREFIX rows between fleet
+replicas (cross-replica plane adoption): ``capture_prefix_row`` snapshots
+one shared-prefix row's first ``span`` positions — int8 codes and their
+scales ship AS STORED, never dequantized — and ``restore_prefix_row``
+writes them into a row of another replica's pool, where aliasing reads
+them exactly as if that replica had prefilled the prefix itself.
 """
+
+import time
 
 import jax
 import jax.numpy as jnp
 
 # Plane-like pool entries sliced along the slot axis (axis 1).
 _PLANE_KEYS = ("k", "v", "k_scale", "v_scale")
+
+# Prefix-plane pool entries sliced along the row axis (axis 1).
+_PREFIX_PLANE_KEYS = ("pk", "pv", "pk_scale", "pv_scale")
+
+# Swap-victim blend: one second since a session's last emitted token
+# counts like this many tokens of remaining budget. An idle session
+# (a stalled client, a long think-time gap) becomes the preferred
+# victim well before the largest-budget active session does.
+IDLE_WEIGHT_TOKENS_PER_S = 32.0
 
 
 def capture_slot(pool, slot):
@@ -50,6 +68,67 @@ def restore_slot(pool, slot, record):
         else:
             pool[name] = pool[name].at[slot].set(val)
     return pool
+
+
+def pick_swap_victim(candidates, now=None,
+                     idle_weight=IDLE_WEIGHT_TOKENS_PER_S):
+    """The decoding session that can best afford to wait: remaining
+    budget BLENDED with last-touch age, not budget order alone.
+
+    Score = (max_new_tokens - emitted) + idle_weight * seconds-since-
+    last-token; highest score is the victim, oldest rid on exact ties.
+    A large residual budget means many decode steps left to amortize
+    the swap; a stale last-touch means the session is not producing and
+    parking it costs nobody latency. Requests without a ``last_touch``
+    stamp score age 0 (budget-only — the pre-blend behavior)."""
+    if not candidates:
+        return None
+    if now is None:
+        now = time.time()
+
+    def _key(r):
+        budget = r.max_new_tokens - len(r.tokens)
+        touched = getattr(r, "last_touch", None)
+        age = 0.0 if touched is None else max(0.0, now - touched)
+        return (budget + idle_weight * age, -r.rid)
+
+    return max(candidates, key=_key)
+
+
+def capture_prefix_row(pool, row, span):
+    """Snapshot prefix row ``row``'s first ``span`` positions to host
+    memory in one batched transfer; returns {name: np.ndarray}.
+
+    The record holds the prefix planes exactly as stored — int8 codes
+    and their fp32 scales when the pool quantizes — so shipping a row
+    to another replica never round-trips through dequantization."""
+    row, span = int(row), int(span)
+    arrs = {}
+    for name in _PREFIX_PLANE_KEYS:
+        if name in pool:
+            arrs[name] = pool[name][:, row, :, :span]
+    return jax.device_get(arrs)
+
+
+def restore_prefix_row(pool, row, record):
+    """Write a captured prefix record into row ``row``; returns the new
+    pool. Eager ``.at[].set`` — unwatched by the recompile detector,
+    zero compiles. The row need not match the one captured (the span
+    travels in the record's shapes), and positions past the span keep
+    whatever the row held — aliasing only ever reads ``[:pbase]``."""
+    row = int(row)
+    pool = dict(pool)
+    for name, val in record.items():
+        val = jnp.asarray(val, pool[name].dtype)
+        span = val.shape[2]  # planes [L, H, span, D]; scales [L, H, span]
+        pool[name] = pool[name].at[:, row, :, :span].set(val)
+    return pool
+
+
+def record_nbytes(record):
+    """Host bytes one captured record occupies (the shipping cost the
+    ``prefix_bytes_shipped`` counter accounts)."""
+    return int(sum(v.nbytes for v in record.values()))
 
 
 class HostSwapStore:

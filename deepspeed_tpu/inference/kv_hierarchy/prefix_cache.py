@@ -76,6 +76,11 @@ class PrefixStore:
         self.trie = RadixTrie()
         self._tick = 0
         self.evictions = 0
+        # Bumped whenever the ROW CONTENTS change (insert / reset) — a
+        # cheap change detector for observers that mirror the row table
+        # (the fleet's prefix directory syncs only when this moves;
+        # acquire/release touch refcounts, not contents, and don't bump).
+        self.version = 0
 
     def _touch(self, row):
         self._tick += 1
@@ -114,6 +119,7 @@ class PrefixStore:
         self.refcount.setdefault(row, 0)
         self._touch(row)
         self.trie.rebuild(self.tokens)
+        self.version += 1
         return row
 
     def reset(self):
@@ -122,3 +128,4 @@ class PrefixStore:
         self.last_use.clear()
         self.attached.clear()
         self.trie = RadixTrie()
+        self.version += 1

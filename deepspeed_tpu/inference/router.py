@@ -45,6 +45,15 @@ BREAKER_STATES = ("closed", "open", "half_open")
 # degraded IS accepting — but new work prefers healthy peers.
 DEGRADED_PENALTY = 4.0
 
+# Prefix-affinity weight: a full-length cached-prefix match (affinity
+# 1.0) is worth this much LOAD — enough to out-rank a peer holding one
+# spare slot on a small replica (1/3 occupancy), not enough to pile
+# work onto an already-saturated prefix holder (occupancy >= 1 beats
+# it). Affinity can never resurrect a dead replica (its score is inf)
+# and never bypasses a breaker (the fleet consults breakers per
+# attempted candidate AFTER ordering).
+AFFINITY_WEIGHT = 0.5
+
 
 class CircuitBreaker(object):
     """Per-replica admission breaker.
@@ -168,11 +177,28 @@ class Router(object):
             load = float("inf")
         return load
 
-    def order(self, views):
+    def order(self, views, affinity=None):
         """Views sorted best-first by score; EXACT score ties break by
         the seeded rng (draws happen in input order, so equal inputs +
-        equal seed = equal output, run after run)."""
-        decorated = [(self.score(v), self._rng.random(), i, v)
-                     for i, v in enumerate(views)]
+        equal seed = equal output, run after run).
+
+        ``affinity`` (optional) is a sequence aligned with ``views`` of
+        cached-prefix affinities in [0, 1] (matched prefix depth over
+        the prefix plane length — the fleet computes it from its prefix
+        directory). Each view's effective score is
+        ``score - AFFINITY_WEIGHT * affinity``: a replica already
+        holding a prompt's prefix wins the route at comparable load,
+        but a dead replica stays inf (affinity never resurrects it) and
+        one rng draw per view still happens in input order, so the
+        seeded tie-break sequence is unchanged from affinity-free
+        ordering."""
+        if affinity is None:
+            decorated = [(self.score(v), self._rng.random(), i, v)
+                         for i, v in enumerate(views)]
+        else:
+            decorated = [
+                (self.score(v) - AFFINITY_WEIGHT * float(a),
+                 self._rng.random(), i, v)
+                for i, (v, a) in enumerate(zip(views, affinity))]
         decorated.sort(key=lambda t: t[:3])
         return [v for _, _, _, v in decorated]

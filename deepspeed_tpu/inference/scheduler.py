@@ -75,7 +75,7 @@ class Request(object):
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature", "top_k",
                  "eos_token_id", "seed", "spec", "tokens", "slot", "phase",
                  "cursor", "submit_time", "admit_time", "first_token_time",
-                 "finish_time", "deadline", "replays")
+                 "finish_time", "deadline", "replays", "last_touch")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature, top_k,
                  eos_token_id, seed, spec=False, deadline=None):
@@ -113,6 +113,12 @@ class Request(object):
         # emitted stream stays one stream across replays — tokens only
         # ever grow.
         self.replays = 0
+        # Wall clock of the last PROGRESS this request made (submit,
+        # then each step that emitted it tokens — the engine stamps at
+        # harvest). The swap-victim policy reads it: staleness here
+        # means an idle session whose slot is cheap to park
+        # (kv_hierarchy.offload.pick_swap_victim).
+        self.last_touch = self.submit_time
 
     @property
     def done(self):

@@ -24,9 +24,10 @@ import math
 
 from deepspeed_tpu.loadgen import slo as slo_mod
 
-SCHEMA_VERSION = 2  # v2: + chaos section (recovery/requests_lost) and
-# per-sample terminal phase — additive, but comparisons across versions
-# deserve the gate's schema caveat.
+SCHEMA_VERSION = 3  # v2: + chaos section (recovery/requests_lost) and
+# per-sample terminal phase. v3: + prefix section (hit rate, bytes
+# shipped by cross-replica adoption, affinity-routed count) — additive,
+# but comparisons across versions deserve the gate's schema caveat.
 
 # Gate polarity: which direction is a REGRESSION for each report
 # metric. Lower-is-better latencies only fail when they grow;
@@ -123,6 +124,25 @@ def _chaos_section(result, slo):
     }
 
 
+def _prefix_section(result):
+    """Prefix-cache facts for the run (stable schema — an engine with
+    no prefix cache shows zeros and a null hit rate). The counters are
+    run DELTAS the runner read back; ``hit_rate`` is the headline the
+    fleet-affinity A/B compares: hits / probes, null when the run never
+    probed (so a disabled cache is distinguishable from a 0% one)."""
+    hits = int(getattr(result, "prefix_hits", 0))
+    misses = int(getattr(result, "prefix_misses", 0))
+    probes = hits + misses
+    return {
+        "prefix_hits": hits,
+        "prefix_misses": misses,
+        "hit_rate": (hits / probes) if probes else None,
+        "prefix_bytes_shipped": int(
+            getattr(result, "prefix_bytes_shipped", 0)),
+        "affinity_routed": int(getattr(result, "affinity_routed", 0)),
+    }
+
+
 def build_report(spec, result, slo, chips=1, platform=None, extra=None):
     """Fold one RunResult into the report document.
 
@@ -166,6 +186,7 @@ def build_report(spec, result, slo, chips=1, platform=None, extra=None):
         },
         "slo": slo_section,
         "chaos": _chaos_section(result, slo),
+        "prefix": _prefix_section(result),
         "timeseries": {
             "window_seconds": result.collector.window_seconds,
             "windows_total": result.collector._idx,

@@ -58,6 +58,14 @@ class RunResult:
     recovery: list = dataclasses.field(default_factory=list)
     requests_lost: int = 0
     faults_injected: int = 0
+    # Prefix-cache facts (zero on engines/fleets without one): counter
+    # DELTAS across this run — probe hits/misses, bytes the fleet
+    # shipped in cross-replica adoptions, and requests whose route was
+    # won (or made good) by prefix affinity.
+    prefix_hits: int = 0
+    prefix_misses: int = 0
+    prefix_bytes_shipped: int = 0
+    affinity_routed: int = 0
 
 
 def _sample_row(lr, req):
@@ -141,9 +149,16 @@ class SustainedRunner(object):
         injector = None
         recoveries_at_start = len(getattr(self.engine, "recovery_log", []))
         counters = getattr(self.engine, "counters", None)
-        faults_at_start = (counters["faults_injected"]
-                           if counters is not None and
-                           "faults_injected" in counters else 0)
+
+        def _counter(name):
+            if counters is not None and name in counters:
+                return counters[name]
+            return 0
+
+        faults_at_start = _counter("faults_injected")
+        prefix_at_start = {n: _counter(n) for n in (
+            "prefix_hits", "prefix_misses", "prefix_bytes_shipped",
+            "affinity_routed")}
         while i < len(pending) or not self.engine.idle:
             now = self._clock() - t0
             if (self.chaos_plan is not None and injector is None
@@ -215,4 +230,12 @@ class SustainedRunner(object):
             requests_lost=lost,
             faults_injected=(0 if counters is None or
                              "faults_injected" not in counters else
-                             counters["faults_injected"] - faults_at_start))
+                             counters["faults_injected"] - faults_at_start),
+            prefix_hits=_counter("prefix_hits")
+            - prefix_at_start["prefix_hits"],
+            prefix_misses=_counter("prefix_misses")
+            - prefix_at_start["prefix_misses"],
+            prefix_bytes_shipped=_counter("prefix_bytes_shipped")
+            - prefix_at_start["prefix_bytes_shipped"],
+            affinity_routed=_counter("affinity_routed")
+            - prefix_at_start["affinity_routed"])

@@ -234,6 +234,41 @@ class WorkloadSpec:
         DIFFERENT workloads measures the workloads)."""
         return dataclasses.asdict(self)
 
+    @classmethod
+    def template_heavy(cls, **overrides):
+        """Template-dominated traffic: a SMALL pool of long shared
+        system prompts (Zipf-skewed, so two templates carry most of the
+        mass) with short unique tails — the workload shape the fleet
+        prefix directory and prefix-affinity routing are built for. A
+        prompt is ``prefix_tokens`` shared tokens plus a 2..~48-token
+        per-request tail (the lognormal prompt-length draw minus the
+        prefix; tails are unique because each request tiles its own
+        phrase draw). Deterministic per ``seed`` like every spec —
+        same-seeded calls produce byte-identical streams. Tests override
+        geometry down (prefix_tokens, prompt bounds) to fit tiny-engine
+        max_len; the defaults fit the serve-bench engine."""
+        params = dict(
+            arrival="poisson",
+            rate=8.0,
+            n_requests=64,
+            prefix_pool=4,
+            prefix_tokens=48,
+            prefix_zipf_a=1.3,
+            prompt_dist="lognormal",
+            prompt_mean=60,
+            prompt_sigma=0.15,
+            prompt_min=50,
+            prompt_max=96,
+            phrase_len=4,
+            output_dist="lognormal",
+            output_mean=16,
+            output_sigma=0.3,
+            output_min=4,
+            output_max=32,
+        )
+        params.update(overrides)
+        return cls(**params)
+
 
 # ------------------------------------------------------------------ trace
 

@@ -32,6 +32,7 @@ from deepspeed_tpu.inference.kv_hierarchy import (
     PrefixStore,
     RadixTrie,
     capture_slot,
+    pick_swap_victim,
     restore_slot,
 )
 from tests.unit.test_chunked_prefill import (
@@ -132,6 +133,37 @@ def test_host_swap_store_capacity_and_roundtrip():
         st.put(8, {"pos": 4})
     assert st.pop(99) is None
     assert st.pop(7) == {"pos": 3} and st.capacity_left()
+
+
+def test_pick_swap_victim_blends_idle_age_into_budget():
+    """Victim score = residual budget + idle_weight * seconds idle:
+    budget order alone decides among equally-fresh sessions, a long-idle
+    small-budget session overtakes them, and exact ties break to the
+    oldest rid deterministically."""
+    import types
+
+    def req(rid, emitted, budget, touch):
+        return types.SimpleNamespace(rid=rid, tokens=[0] * emitted,
+                                     max_new_tokens=emitted + budget,
+                                     last_touch=touch)
+
+    now = 1000.0
+    assert pick_swap_victim([]) is None
+    # Equal last_touch: the largest residual budget is the victim.
+    fresh = [req(0, 2, 30, now), req(1, 2, 8, now), req(2, 2, 19, now)]
+    assert pick_swap_victim(fresh, now=now).rid == 0
+    # A stalled small-budget session wins once idle_weight * age
+    # dominates: 8 + 32 * 2.0 = 72 > 30.
+    stale = [req(0, 2, 30, now), req(1, 2, 8, now - 2.0)]
+    assert pick_swap_victim(stale, now=now).rid == 1
+    # ...but not for a sub-threshold stall: 8 + 32 * 0.5 = 24 < 30.
+    warm = [req(0, 2, 30, now), req(1, 2, 8, now - 0.5)]
+    assert pick_swap_victim(warm, now=now).rid == 0
+    # Exact score tie: the oldest rid is the deterministic victim, and
+    # a missing last_touch stamp scores age 0 (budget-only).
+    tied = [req(5, 0, 12, now), req(3, 0, 12, now),
+            types.SimpleNamespace(rid=9, tokens=[], max_new_tokens=12)]
+    assert pick_swap_victim(tied, now=now).rid == 3
 
 
 # ---------------------------------------------------------- bit-identity

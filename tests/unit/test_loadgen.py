@@ -153,6 +153,36 @@ def test_workload_prefix_pool_off_is_legacy_stream():
         assert x.seed == y.seed
 
 
+def test_workload_template_heavy_preset():
+    """The ``template_heavy`` preset is template-dominated by
+    construction: every prompt opens with one of a SMALL pool of long
+    shared heads, the Zipf skew makes the top template carry the most
+    mass, and same-seeded calls stay byte-identical. Overrides pass
+    straight through (how tests shrink it to tiny-engine geometry)."""
+    a = WorkloadSpec.template_heavy(seed=9).requests()
+    b = WorkloadSpec.template_heavy(seed=9).requests()
+    assert len(a) == 64
+    for x, y in zip(a, b):
+        assert x.arrival_s == y.arrival_s
+        assert np.array_equal(x.prompt, y.prompt)
+        assert x.seed == y.seed
+    heads = [tuple(r.prompt[:48]) for r in a]
+    pool = sorted(set(heads))
+    assert 1 <= len(pool) <= 4               # every head from the pool
+    counts = sorted((heads.count(h) for h in pool), reverse=True)
+    assert counts[0] >= len(a) // 4          # Zipf: one template dominates
+    assert all(50 <= r.prompt.size <= 96 for r in a)
+    assert all(4 <= r.max_new_tokens <= 32 for r in a)
+    # Overrides shrink the geometry without losing the template shape.
+    small = WorkloadSpec.template_heavy(
+        seed=9, n_requests=8, prefix_pool=2, prefix_tokens=6,
+        prompt_mean=12, prompt_min=10, prompt_max=20,
+        output_max=6).requests()
+    assert len(small) == 8
+    assert len({tuple(r.prompt[:6]) for r in small}) <= 2
+    assert all(10 <= r.prompt.size <= 20 for r in small)
+
+
 def test_workload_prefix_pool_trace_roundtrip(tmp_path):
     """Shared-prefix streams replay exactly through the JSONL trace
     path (explicit token ids — the prefix structure survives)."""
@@ -432,6 +462,48 @@ def test_runner_records_queuefull_as_shed_samples():
     assert rep["slo"]["attainment"] < 1.0
 
 
+def test_report_prefix_section_counts_hits_and_misses():
+    """Template-heavy traffic against a prefix-cache engine: the runner
+    records counter DELTAS (hits > 0 once the pool re-serves a head) and
+    the report's v3 ``prefix`` section carries them with a real
+    hit_rate. An engine without the cache never probes — hit_rate is
+    None, not 0.0."""
+    cfg, model, params = make_model()
+    engine = engine_of(model, params, prefix_cache=True, prefix_slots=4,
+                       prefix_len=16, min_prefix_len=4)
+    _warm(engine)
+    spec = WorkloadSpec.template_heavy(
+        seed=13, rate=200.0, n_requests=16, prefix_pool=2,
+        prefix_tokens=8, prompt_mean=14, prompt_min=12, prompt_max=24,
+        output_min=2, output_max=6, vocab_size=cfg.vocab_size)
+    res = SustainedRunner(engine, spec, window_seconds=0.1,
+                          max_steps=100_000).run()
+    assert res.completed == 16
+    assert res.prefix_hits > 0
+    assert res.prefix_hits + res.prefix_misses >= 16
+    rep = build_report(spec, res, SLO(ttft_p99_ms=1e4, itl_p99_ms=2e3))
+    assert rep["schema_version"] == 3
+    sec = rep["prefix"]
+    assert sec["prefix_hits"] == res.prefix_hits
+    assert sec["prefix_misses"] == res.prefix_misses
+    assert sec["hit_rate"] == pytest.approx(
+        res.prefix_hits / (res.prefix_hits + res.prefix_misses))
+    # Single engine: nothing shipped, nothing affinity-routed.
+    assert sec["prefix_bytes_shipped"] == 0
+    assert sec["affinity_routed"] == 0
+    json.dumps(rep)
+    engine.close()
+
+    plain = engine_of(model, params)
+    _warm(plain)
+    res2 = SustainedRunner(plain, spec, window_seconds=0.1,
+                          max_steps=100_000).run()
+    assert res2.prefix_hits == 0 and res2.prefix_misses == 0
+    rep2 = build_report(spec, res2, SLO(ttft_p99_ms=1e4, itl_p99_ms=2e3))
+    assert rep2["prefix"]["hit_rate"] is None
+    plain.close()
+
+
 # ------------------------------------------------------------- saturation
 
 
@@ -472,7 +544,7 @@ def test_bench_sustained_smoke_report():
     assert result["unit"] == "tokens/s/chip"
     assert result["value"] > 0
     rep = result["extra"]["sustained"]
-    assert rep["schema_version"] == 2
+    assert rep["schema_version"] == 3
     wins = rep["timeseries"]["windows"]
     carrying = [w for w in wins
                 if w["ttft_p99_ms"] is not None
@@ -546,7 +618,7 @@ def test_chaos_section_empty_on_fault_free_run():
     assert res.recovery == [] and res.requests_lost == 0
     assert res.faults_injected == 0
     rep = build_report(spec, res, SLO(ttft_p99_ms=1e4, itl_p99_ms=2e3))
-    assert rep["schema_version"] == 2
+    assert rep["schema_version"] == 3
     chaos = rep["chaos"]
     assert chaos["recoveries"] == 0 and chaos["recovery_time_s"] == 0.0
     assert chaos["requests_during_recovery"] == 0
@@ -573,7 +645,7 @@ def test_bench_chaos_smoke_report():
     assert extra["requests_lost"] == 0
     assert extra["recoveries"] >= 1 and extra["faults_injected"] >= 1
     rep = extra["chaos_report"]
-    assert rep["schema_version"] == 2
+    assert rep["schema_version"] == 3
     assert rep["chaos"]["requests_lost"] == 0
     assert rep["context"]["fault_plan"]["faults"][0]["kind"] == "raise"
 
