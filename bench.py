@@ -1583,6 +1583,182 @@ def main_fleet(smoke=False, prefix_affinity=True):
     return 0
 
 
+def _measure_disagg(smoke=False, disagg=True):
+    """`bench.py --fleet-smoke --disagg`: the disaggregation ITL A/B as
+    a benchmark artifact.
+
+    A 3-replica fleet (1 prefill + 2 decode under --disagg; the same
+    three replicas all-mixed under --no-disagg, metric suffixed
+    _nodisagg) serves one seeded open-loop stream of long-prompt
+    requests. On the mixed side every replica's decode steps share the
+    step program with live prefill lanes — each chunk of someone else's
+    prompt rides the same dispatch, inflating inter-token latency for
+    every decoding request in the batch. On the disagg side decode
+    replicas never run a prefill lane (prompts arrive as finished KV
+    planes via handoff), so their ITL reflects decode work alone. The
+    artifact stamps ITL p50/p99 plus the handoff counters, and asserts
+    the run itself was sound: zero requests lost, no re-prefill
+    fallbacks, one compile per replica. The strictly-lower-p99
+    acceptance is pinned in tests/unit/test_disagg.py, which runs both
+    sides in one process."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference import InferenceConfig, ServingFleet
+    from deepspeed_tpu.loadgen import (
+        SLO,
+        SustainedRunner,
+        WorkloadSpec,
+        build_report,
+    )
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu" and not smoke
+    if on_tpu:
+        cfg = GPT2Config.gpt2_medium(dropout=0.0, use_flash_attention=True)
+        serve_cfg = {"max_slots": 8, "max_len": 512, "chunk_size": 8,
+                     "prefill_chunk": 16, "max_queue": 128}
+        base = dict(arrival="poisson", rate=12.0, n_requests=64,
+                    prompt_dist="lognormal", prompt_mean=192,
+                    prompt_max=384, output_dist="fixed", output_mean=48,
+                    output_max=48, vocab_size=cfg.vocab_size, seed=23)
+        window_s, slo = 2.0, SLO(ttft_p99_ms=2000.0, itl_p99_ms=200.0)
+    else:
+        cfg = GPT2Config.tiny(dropout=0.0, use_flash_attention=False)
+        # Long prompts against a small prefill_chunk: each prompt takes
+        # many prefill steps, so on the mixed side decode steps almost
+        # always carry a prefill lane — the interference the A/B exists
+        # to expose.
+        serve_cfg = {"max_slots": 4, "max_len": 96, "chunk_size": 2,
+                     "prefill_chunk": 8, "max_queue": 128}
+        # Outputs long enough (23 inter-token gaps) that the one
+        # handoff gap per request amortizes instead of dominating the
+        # per-request ITL.
+        base = dict(arrival="poisson", rate=60.0, n_requests=24,
+                    prompt_dist="fixed", prompt_mean=32, prompt_max=48,
+                    output_dist="fixed", output_mean=24, output_max=24,
+                    vocab_size=cfg.vocab_size, seed=23)
+        window_s = 0.1
+        # Schema-exercise budgets (CPU jitter; the A/B compares the two
+        # sides, not either side against the SLO).
+        slo = SLO(ttft_p99_ms=30000.0, itl_p99_ms=10000.0)
+
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(0, cfg.vocab_size, size=(2, 16))
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(init_ids))["params"]
+
+    roles = ("prefill", "decode", "decode") if disagg else None
+    # idle_wait_s: an idle decode replica polls the handoff pump at this
+    # cadence — the default 10ms is a visible slice of a tiny-model
+    # inter-token gap, so the smoke tightens it.
+    fleet = ServingFleet(model, params, n_replicas=3,
+                         config=InferenceConfig.from_dict(serve_cfg),
+                         window_seconds=window_s, seed=0, roles=roles,
+                         idle_wait_s=0.01 if on_tpu else 0.002)
+    # Warmup (the SustainedRunner contract: the caller owns compile).
+    # Six short requests spread across the least-loaded routing so every
+    # replica compiles BEFORE the measured stream — on the disagg side a
+    # decode replica compiles on its first adoption, and an un-warmed
+    # acceptor stalls the handoff pump (and with it the prefill replica)
+    # for the whole compile, which would poison the first window of the
+    # A/B on both sides.
+    warm_rng = np.random.RandomState(7)
+    for i in range(6):
+        fleet.submit(
+            warm_rng.randint(
+                0, cfg.vocab_size,
+                size=int(base["prompt_mean"])).astype(np.int32),
+            max_new_tokens=8, temperature=0.0, seed=900 + i)
+    assert fleet.wait_idle(timeout_s=300.0), "warmup did not settle"
+    assert all(c == 1 for c in fleet.compile_counts.values()), \
+        "warmup left a cold replica: {}".format(fleet.compile_counts)
+    fleet.metrics(reset=True)
+    spec = WorkloadSpec(**base)
+    # The runner reads counter DELTAS for the report's disagg section;
+    # mirror that for handoffs_in so warmup traffic stays out of the
+    # stamped numbers.
+    handoffs_in_start = int(fleet.counters["handoffs_in"])
+    runner = SustainedRunner(fleet, spec, window_seconds=window_s,
+                             max_steps=500_000)
+    result = runner.run()
+    handoffs_in = int(fleet.counters["handoffs_in"]) - handoffs_in_start
+    report = build_report(
+        spec, result, slo, platform=platform,
+        extra={"git_hash": _git_state(),
+               "model": "gpt2_medium" if on_tpu else "gpt2_tiny",
+               "serve_cfg": dict(serve_cfg),
+               "roles": list(fleet.roles)})
+    compile_counts = fleet.compile_counts
+    health = fleet.health
+    fleet.close()
+
+    # Soundness of the run itself (the cross-side comparison lives in
+    # tests/unit/test_disagg.py).
+    assert result.requests_lost == 0, \
+        "disagg run lost {} request(s)".format(result.requests_lost)
+    assert result.shed == 0, "queue shed {} request(s)".format(result.shed)
+    assert health == "healthy", "fleet unhealthy at exit: {}".format(
+        health)
+    assert all(c == 1 for c in compile_counts.values()), \
+        "expected one compile per replica, got {}".format(compile_counts)
+    if disagg:
+        assert result.handoffs > 0, "disagg run performed no handoffs"
+        assert result.handoff_fallbacks == 0, \
+            "{} re-prefill fallback(s) in a fault-free run".format(
+                result.handoff_fallbacks)
+    else:
+        assert result.handoffs == 0, \
+            "all-mixed fleet performed {} handoff(s)".format(
+                result.handoffs)
+
+    agg = report["aggregate"]
+    name = "gpt2_{}_disagg_decode_itl_p99_ms".format(
+        "355m" if on_tpu else "tiny_smoke")
+    if not disagg:
+        # A/B runs must not share last-good bookkeeping with the
+        # disagg-on series.
+        name += "_nodisagg"
+    return {
+        "metric": name,
+        "value": round(agg["itl_p99_ms"], 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "extra": {
+            "platform": platform,
+            "disagg": bool(disagg),
+            "roles": list(fleet.roles),
+            "n_requests": int(base["n_requests"]),
+            "offered_rate": float(base["rate"]),
+            "itl_p50_ms": agg["itl_p50_ms"],
+            "itl_p99_ms": agg["itl_p99_ms"],
+            "ttft_p99_ms": agg["ttft_p99_ms"],
+            "requests_lost": int(result.requests_lost),
+            "handoffs": int(result.handoffs),
+            "handoffs_in": handoffs_in,
+            "handoff_fallbacks": int(result.handoff_fallbacks),
+            "handoff_bytes_shipped": int(result.handoff_bytes_shipped),
+            "compile_counts": {str(k): v
+                               for k, v in compile_counts.items()},
+            "fleet_health_at_exit": health,
+            "serve_cfg": dict(serve_cfg),
+            "disagg_report": report["disagg"],
+            "note": "ITL A/B vs the _nodisagg suffix at the same "
+                    "offered rate; docs/INFERENCE.md 'Disaggregated "
+                    "prefill/decode' section is the contract",
+        },
+    }
+
+
+def main_disagg(smoke=False, disagg=True):
+    if not smoke:
+        _require_tpu_or_exit()
+    _emit(_measure_disagg(smoke=smoke, disagg=disagg))
+    return 0
+
+
 def main_bert(sparse=False):
     _require_tpu_or_exit()
     _measure_bert(sparse=sparse, steps=12)
@@ -1632,6 +1808,11 @@ def _dispatch(argv):
     # prefix-affinity A/B (--fleet/--fleet-smoke only; metric suffixed
     # _noprefixaffinity) — per-replica caches stay on, fleet routing
     # ignores them.
+    # --disagg / --no-disagg: the disaggregation ITL A/B (--fleet/
+    # --fleet-smoke only). --disagg runs 1 prefill + 2 decode replicas;
+    # --no-disagg runs the same three replicas all-mixed (metric
+    # suffixed _nodisagg so the series never mix). Either flag routes to
+    # the disagg benchmark instead of the failover one.
     flash_decode = False if "--no-flash-decode" in argv else None
     chunked = "--no-chunked-prefill" not in argv
     spec = "--no-spec-decode" not in argv
@@ -1639,9 +1820,15 @@ def _dispatch(argv):
     prefix_cache = "--no-prefix-cache" not in argv
     host_offload = "--no-host-offload" not in argv
     prefix_affinity = "--no-prefix-affinity" not in argv
+    disagg_ab = "--disagg" in argv or "--no-disagg" in argv
+    disagg_on = "--no-disagg" not in argv
     if "--fleet-smoke" in argv:
+        if disagg_ab:
+            return main_disagg(smoke=True, disagg=disagg_on)
         return main_fleet(smoke=True, prefix_affinity=prefix_affinity)
     if "--fleet" in argv:
+        if disagg_ab:
+            return main_disagg(smoke="--smoke" in argv, disagg=disagg_on)
         return main_fleet(smoke="--smoke" in argv,
                           prefix_affinity=prefix_affinity)
     if "--chaos-smoke" in argv:

@@ -48,6 +48,7 @@ INFERENCE_DEFAULTS = {
     "host_offload": False,
     "swap_slots": 8,
     "hbm_budget_bytes": None,
+    "role": "mixed",
 }
 
 
@@ -198,6 +199,20 @@ class InferenceConfig:
     # footprint as the budget, making the gauge a direct "x more slots
     # at the bytes we used to spend" ratio.
     hbm_budget_bytes: Optional[int] = None
+    # --- Disaggregated prefill/decode serving (inference/fleet.py) ------
+    # Phase role within a ServingFleet. "mixed" (the default) serves
+    # both phases — a standalone engine or a classic fleet replica.
+    # "prefill" runs prompts only: once a request's final chunk lands,
+    # the engine parks it in the ``handoff`` phase and snapshots its KV
+    # slot to a host record for the fleet's handoff pump to migrate.
+    # "decode" advertises that this replica accepts those migrations and
+    # should not be routed new prompts (routing honors it; the engine
+    # itself stays fully capable of prefill — failover re-prefill on a
+    # decode replica is the fallback that keeps zero-lost true). Both
+    # non-mixed roles ride the mixed-step program (the prefill lane is
+    # lax.cond-skipped when unused), so compile_count stays 1 either
+    # way. Requires chunked_prefill.
+    role: str = "mixed"
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -266,6 +281,15 @@ class InferenceConfig:
         if self.swap_slots < 1:
             raise ValueError("inference.swap_slots must be >= 1, got "
                              "{}".format(self.swap_slots))
+        if self.role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                "inference.role must be one of 'mixed'/'prefill'/'decode', "
+                "got {!r}".format(self.role))
+        if self.role != "mixed" and not self.chunked_prefill:
+            raise ValueError(
+                "inference.role={!r} requires chunked_prefill: the handoff "
+                "capture rides the mixed-step path (the legacy bucket path "
+                "has no step boundary to capture at)".format(self.role))
         if self.hbm_budget_bytes is not None and self.hbm_budget_bytes <= 0:
             raise ValueError(
                 "inference.hbm_budget_bytes must be > 0 (or None for the "

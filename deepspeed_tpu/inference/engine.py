@@ -92,6 +92,7 @@ from deepspeed_tpu.inference.kv_hierarchy import (
     KVHierarchy,
     capture_prefix_row,
     capture_slot,
+    capture_slots,
     pick_swap_victim,
     record_nbytes,
     restore_prefix_row,
@@ -458,6 +459,12 @@ class InferenceEngine(object):
         "_injector",        # fault plan, swapped between steps
         "_recovery_streak", "_last_swap_out_s",
         "_accept_hist", "_accept_base", "_window_t0",
+        # Disaggregated handoff (prefill-role engines): the outbox of
+        # captured (req, record, t) triples the fleet pump drains, and
+        # the capture switch the fleet flips off when no decode-capable
+        # replica survives. Both touched only under the same external
+        # serialization as step() itself.
+        "_handoff_outbox", "_handoff_enabled",
     })
 
     def __init__(self, model, params, config=None, mesh=None):
@@ -587,7 +594,16 @@ class InferenceEngine(object):
             # fleet increments the latter; a standalone engine keeps
             # them at zero.
             "prefix_adoptions", "prefix_bytes_shipped",
-            "affinity_routed"))
+            "affinity_routed",
+            # Disaggregated prefill/decode (docs/INFERENCE.md):
+            # ``handoffs`` counts captures on a prefill-role donor,
+            # ``handoffs_in`` adoptions on a decode acceptor,
+            # ``handoff_fallbacks`` migrations that re-prefilled on a
+            # survivor instead, ``handoff_bytes_shipped`` the host bytes
+            # the captured records moved. Zero forever outside a
+            # role-typed fleet.
+            "handoffs", "handoffs_in", "handoff_fallbacks",
+            "handoff_bytes_shipped"))
         if self._hier is not None:
             # The hierarchy increments hits/misses/inserts itself; hand
             # it the bank so those land in the same registry counters.
@@ -637,6 +653,20 @@ class InferenceEngine(object):
         self._ttft_hist = self.telemetry.histogram("ttft_seconds")
         self._itl_hist = self.telemetry.histogram("inter_token_seconds")
         self._qwait_hist = self.telemetry.histogram("queue_wait_seconds")
+        # Disaggregated serving (fleet roles). The role is a routing/
+        # capture contract, not a program variant: every role runs the
+        # same mixed-step program (the prefill lane cond-skips when
+        # unused), so compile_count stays 1 per replica whatever the
+        # role. ``_handoff_outbox`` holds (req, record, t_capture)
+        # triples between a prefill-role step's capture and the fleet
+        # pump's drain; the latency histogram spans capture -> adopt
+        # (the pump observes it — on the donor's registry, so the
+        # migration cost is attributed to the replica that sheds it).
+        self.role = config.role
+        self._handoff_enabled = config.role == "prefill"
+        self._handoff_outbox = []
+        self._handoff_latency_hist = self.telemetry.histogram(
+            "handoff_latency_seconds")
         # accepted-tokens-per-occupied-slot-step histogram (index =
         # count, 1..spec_k+1; index 0 stays empty — an occupied step
         # always emits at least the bonus token). Bounded memory
@@ -1139,6 +1169,104 @@ class InferenceEngine(object):
         self.counters["prefix_bytes_shipped"] += record_nbytes(record)
         return True
 
+    # ------------------------------------------- disaggregated handoff
+
+    def _capture_handoffs(self):
+        """Prefill-role step epilogue: every request whose prompt just
+        finished (phase ``decoding``, still active) leaves the slot
+        pool for the handoff outbox — ALL of them in ONE batched host
+        transfer (capture_slots — the same one-transfer-per-chunk
+        discipline as harvest_snapshot). A record is the slot's
+        complete device truth: KV planes exactly as stored (int8 codes
+        + scales ship without a dequantize round-trip) plus every
+        per-slot scalar, ``pos`` included, so the acceptor's positional
+        fold_in(seed, pos) rng continues the stream bit-identically.
+        Slots deactivate and free here — the next admission round
+        reuses them for fresh prompts, which is the whole point of a
+        prefill-only replica."""
+        pending = [r for r in self._scheduler.running.values()
+                   if r.phase == "decoding"]
+        if not pending:
+            return
+        slots = [r.slot for r in pending]
+        t0 = time.time()
+        records = capture_slots(self._pool, slots)
+        self._pool = dict(self._pool, active=self._pool["active"]
+                          .at[jnp.asarray(slots, jnp.int32)].set(False))
+        for req, record in zip(pending, records):
+            self._scheduler.begin_handoff(req)
+            self._handoff_outbox.append((req, record, t0))
+            self.counters["handoff_bytes_shipped"] += record_nbytes(record)
+        self.counters["handoffs"] += len(pending)
+
+    def take_handoffs(self):
+        """Drain the handoff outbox: (Request, record, t_capture)
+        triples for the fleet pump to migrate. Caller must hold this
+        engine's serialization lock — the outbox is stepper-owned state,
+        exactly like the pool it was captured from."""
+        out, self._handoff_outbox = self._handoff_outbox, []
+        return out
+
+    def finish_handoff(self, req):
+        """Donor-side epilogue once a migration settled (adopted by a
+        peer, or fallen back to re-prefill on a survivor): forget the
+        scheduler record and unpin any prefix row the request aliased
+        here. Idempotent against a concurrent cancel (both paths
+        tolerate the already-released record). Caller holds the
+        serialization lock."""
+        self._scheduler.finish_handoff(req)
+        if self._hier is not None:
+            self._hier.on_release(req)
+
+    def adopt_handoff(self, spec, record):
+        """ACCEPTOR half of disaggregated handoff: install a request
+        captured on a prefill-role peer straight into a free slot in
+        the ``decoding`` phase — no queue, no prefill lane, the restored
+        plane IS the prefill. ``spec`` is the durable residual
+        resubmission spec (prompt = original + tokens emitted on the
+        donor, residual budget, sampling params + seed, and the donor's
+        submit/admit/first-token stamps so queue-wait and TTFT are
+        observed exactly once, where they actually happened); ``record``
+        the captured slot. Returns the new Request, or None when this
+        engine cannot take it right now — no free slot, or the record
+        aliases a prefix span this replica's store does not hold (the
+        pump ships the row and retries, or falls back). Caller must
+        hold this engine's serialization lock."""
+        if self._health.state == "dead":
+            return None
+        free = self._scheduler.free_slot_ids()
+        if not free:
+            return None
+        pbase = int(np.asarray(record["pbase"])) if "pbase" in record else 0
+        if pbase > 0:
+            # The slot's private plane only holds the suffix past the
+            # aliased span — adoption is only sound if WE hold the same
+            # prefix content to alias. Peek before committing anything.
+            hier = self._hier
+            if hier is None or hier.store is None:
+                return None
+            row, depth = hier.store.lookup(
+                [int(t) for t in spec["prompt"]])
+            if row is None or depth < pbase:
+                return None
+        slot = free[0]
+        req = self._scheduler.adopt(
+            spec["prompt"], spec["max_new_tokens"], spec["temperature"],
+            spec["top_k"], spec["eos_token_id"], spec["seed"], slot,
+            spec=spec["spec"], deadline=spec["deadline"],
+            submit_time=spec["submit_time"], admit_time=spec["admit_time"],
+            first_token_time=spec["first_token_time"])
+        if pbase > 0:
+            # Re-pin under the same lock the peek ran under — nothing
+            # can have moved between them. The donor's pid named a row
+            # in the DONOR's store; patch it to ours.
+            row = self._hier.on_handoff_in(req, pbase)
+            record = dict(record)
+            record["pid"] = np.int32(row)
+        self._pool = restore_slot(self._pool, slot, record)
+        self.counters["handoffs_in"] += 1
+        return req
+
     def _step_chunked(self):
         done = []
         offload = self._hier is not None and self._hier.spec.offload
@@ -1248,6 +1376,13 @@ class InferenceEngine(object):
                 req.last_touch = harvest_t
             if not active[slot]:
                 self._complete(req, done)
+        if self._handoff_enabled:
+            # Prefill role: everything still decoding after this step's
+            # harvest (its prompt just finished, same-step tokens kept —
+            # they are part of the one bit-identical stream) leaves for
+            # the handoff outbox in one batched capture. Requests that
+            # COMPLETED this step already finished locally above.
+            self._capture_handoffs()
         self._observe_compiles()
         return done
 
@@ -1465,6 +1600,17 @@ class InferenceEngine(object):
             "requests_replayed": c.window("requests_replayed"),
             "deadline_sheds": c.window("deadline_sheds"),
             "step_stalls": c.window("step_stalls"),
+            # Disaggregated serving (inference/fleet.py): this engine's
+            # side of the KV-plane handoff traffic. ``handoffs`` counts
+            # donor captures (prefill role), ``handoffs_in`` acceptor
+            # adoptions (decode role), fallbacks the re-prefills taken
+            # when no decode-capable peer could adopt. All zero on a
+            # standalone or all-mixed engine.
+            "role": self.role,
+            "handoffs": c.window("handoffs"),
+            "handoffs_in": c.window("handoffs_in"),
+            "handoff_fallbacks": c.window("handoff_fallbacks"),
+            "handoff_bytes_shipped": c.window("handoff_bytes_shipped"),
         }
         if self._spec is not None:
             hist = self._accept_hist - self._accept_base

@@ -69,6 +69,30 @@ the scheduler, bypassing admission health, because ACCEPTED IS A
 PROMISE: a draining survivor still takes failover work, and a full one
 is retried until a slot frees (``idle`` stays False while orphans
 exist, so drive loops keep pumping).
+
+Disaggregated prefill/decode serving (this PR): ``roles=`` types each
+replica ``prefill`` / ``decode`` / ``mixed`` (default all-``mixed`` —
+nothing above changes unless you opt in). The router sends NEW requests
+only to prefill-capable replicas (role eligibility SKIPS ineligible
+views before scoring — no score, no tie-break rng draw — so an
+all-mixed fleet routes bit-identically to before); when a prompt's
+final chunk lands on a prefill replica, the engine captures the
+finished slot — every plane exactly as stored, int8 codes + scales
+never dequantized, all completers of one step in ONE batched transfer —
+and the fleet's ``HandoffPump`` migrates the stream into a
+decode-capable replica's slot pool, chosen by the same health/affinity
+ordering. The acceptor installs it straight into the ``decoding`` phase
+(the restored record IS the prefill), so decode replicas never run a
+prefill lane and their inter-token latency is interference-free. The
+durable host-side record plus the residual respec (prompt + emitted,
+residual budget, positional ``fold_in(seed, pos)`` rng) keep every
+stream bit-identical to a single-engine run whatever happens
+mid-migration: cancel reaches a mid-handoff stream (the pump's commit
+and the cancel path serialize on the fleet lock), donor death drops the
+pump item and replays via the normal orphan path, and when NO
+decode-capable replica survives, the surviving prefill replicas degrade
+to effective-mixed (capture disabled) and the stream re-prefills on a
+survivor — zero lost, counted as ``handoff_fallbacks``.
 """
 
 import dataclasses
@@ -293,6 +317,49 @@ class _FleetCounters(object):
         return [(n, self[n]) for n in self]
 
 
+class HandoffPump(object):
+    """In-flight KV-plane migrations, donor -> decode replica. One per
+    fleet; every replica thread (and the single-threaded ``step()``
+    driver) drains it, so a migration never depends on any particular
+    thread surviving. Items are ``(fr, donor_rep, req, record,
+    t_capture)`` tuples: the fleet handle, the prefill replica that
+    captured, its (slotless, phase-``handoff``) engine Request, the
+    host-side slot record, and the capture wall clock the donor's
+    ``handoff_latency_seconds`` histogram observes at commit.
+
+    Thread contract: ``claim()`` atomically empties the list, so
+    concurrent pumps from several replica threads each get disjoint
+    items and never double-place one stream; ``requeue()`` puts
+    unplaceable items back at the FRONT (oldest migration retries
+    first). Every attribute write outside ``__init__`` holds
+    ``self.lock`` — graftlint THREADRACE checks this class."""
+
+    _THREAD_OWNED = frozenset()
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.pending = []
+        self.total = 0
+
+    def put(self, items):
+        with self.lock:
+            self.pending.extend(items)
+            self.total += len(items)
+
+    def claim(self):
+        with self.lock:
+            items, self.pending = self.pending, []
+        return items
+
+    def requeue(self, items):
+        with self.lock:
+            self.pending = list(items) + self.pending
+
+    def __len__(self):
+        with self.lock:
+            return len(self.pending)
+
+
 class ServingFleet(object):
     """N replicas, one submit()/harvest()/cancel()/drain() surface.
 
@@ -317,7 +384,7 @@ class ServingFleet(object):
     def __init__(self, model, params, n_replicas=2, config=None, seed=0,
                  window_seconds=1.0, window_capacity=512, start=True,
                  breaker_factory=None, idle_wait_s=0.01, poll_s=0.002,
-                 prefix_affinity=None):
+                 prefix_affinity=None, roles=None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1, got "
                              "{}".format(n_replicas))
@@ -325,13 +392,33 @@ class ServingFleet(object):
             config = InferenceConfig.from_dict(config)
         config = config or InferenceConfig()
         self.config = config
+        # Disaggregated serving: one role string per replica. Default —
+        # every replica takes config.role (itself defaulting "mixed"),
+        # so an undecorated fleet behaves exactly as before. Per-role
+        # field validation (and the chunked_prefill requirement) runs in
+        # InferenceConfig.__post_init__ via the per-replica replace().
+        if roles is None:
+            roles = [config.role] * n_replicas
+        roles = [str(r) for r in roles]
+        if len(roles) != n_replicas:
+            raise ValueError(
+                "roles must name one role per replica: got {} for "
+                "{} replicas".format(len(roles), n_replicas))
+        if "prefill" in roles and \
+                not any(r in ("decode", "mixed") for r in roles):
+            raise ValueError(
+                "a prefill-role replica needs at least one decode or "
+                "mixed replica to hand finished prompts to; got "
+                "roles={}".format(roles))
+        self.roles = tuple(roles)
+        self._disagg = any(r != "mixed" for r in roles)
         if breaker_factory is None:
             breaker_factory = CircuitBreaker
         devices = mesh_lib.replica_devices(n_replicas)
         multi_device = len(set(devices)) > 1
         self.replicas = []
         for i in range(n_replicas):
-            cfg = dataclasses.replace(config, replica_id=i)
+            cfg = dataclasses.replace(config, replica_id=i, role=roles[i])
             if multi_device:
                 # Own device per replica: params land there once, and
                 # the engine's pool/programs follow via default_device.
@@ -372,6 +459,7 @@ class ServingFleet(object):
         self._fids = itertools.count()
         self._requests = {}     # fid -> FleetRequest (until harvested)
         self._orphans = []      # FleetRequests awaiting resubmission
+        self._handoffs = HandoffPump()
         self.failovers = 0      # requests moved off dead replicas
         self._idle_wait_s = idle_wait_s
         self._poll_s = poll_s
@@ -401,6 +489,8 @@ class ServingFleet(object):
         while not rep.stop.is_set():
             if self._orphans:
                 self._pump()
+            if self._handoffs.pending:
+                self._pump_handoffs()
             progressed = self._step_replica(rep)
             if rep.failed:
                 return  # dead is terminal; the thread's work is done
@@ -437,6 +527,7 @@ class ServingFleet(object):
             else:
                 self._observe_resilience(rep)
                 self._sync_prefixes(rep)
+                self._collect_handoffs(rep)
         if dead is not None:
             self._failover(rep, dead)
             return False
@@ -479,6 +570,211 @@ class ServingFleet(object):
         self._directory.sync(rep.rid, hier.store.tokens.values())
         rep.last_prefix_version = version
 
+    # ----------------------------------------------- disaggregated handoff
+
+    def _collect_handoffs(self, rep):
+        """Pull freshly captured migrations off a prefill replica's
+        outbox (called under rep.lock, right after a clean step) and
+        enqueue them on the pump. A captured request whose fleet handle
+        is already gone (cancelled AND harvested between capture and
+        collect) settles on the donor immediately."""
+        if not rep.engine._handoff_outbox:
+            return
+        items = []
+        with self._lock:
+            for req, record, t0 in rep.engine.take_handoffs():
+                fr = next((f for f in self._requests.values()
+                           if f._req is req), None)
+                if fr is None:
+                    rep.engine.finish_handoff(req)
+                    continue
+                items.append((fr, rep, req, record, t0))
+        if items:
+            self._handoffs.put(items)
+
+    def _pump_handoffs(self):
+        """Drain the pump: place each claimed migration on a
+        decode-capable replica (or settle it — cancelled, donor-died,
+        or fallen back to re-prefill); what cannot place RIGHT NOW
+        (every acceptor's slot pool full) requeues for the next pass —
+        ``idle`` stays False until the pump empties, so drive loops
+        keep pumping exactly like the orphan path."""
+        items = self._handoffs.claim()
+        if not items:
+            return
+        remaining = [item for item in items
+                     if not self._place_handoff(*item)]
+        if remaining:
+            self._handoffs.requeue(remaining)
+
+    def _build_handoff_spec(self, req):
+        """The durable residual respec for a mid-handoff stream — the
+        same snapshot ``FleetRequest._orphan`` takes (prompt + emitted,
+        residual budget, params + seed, so the positional rng continues
+        bit-identically anywhere), PLUS the donor's submit/admit/first-
+        token stamps so the acceptor adopts them instead of re-stamping
+        (queue-wait and TTFT are observed exactly once, where they
+        happened). Caller holds the fleet lock."""
+        emitted = [int(t) for t in req.tokens]
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        if emitted:
+            prompt = np.concatenate(
+                [prompt, np.asarray(emitted, np.int32)])
+        return {
+            "prompt": prompt,
+            "max_new_tokens": req.max_new_tokens - len(emitted),
+            "temperature": req.temperature,
+            "top_k": req.top_k,
+            "eos_token_id": req.eos_token_id,
+            "seed": req.seed,
+            "spec": req.spec,
+            "deadline": req.deadline,
+            "submit_time": req.submit_time,
+            "admit_time": req.admit_time,
+            "first_token_time": req.first_token_time,
+        }
+
+    def _place_handoff(self, fr, donor, req, record, t0):
+        """One migration attempt. Returns True when the item SETTLED —
+        adopted, dropped (cancelled / donor failed over), or fallen
+        back to re-prefill — and False to retry on a later pass."""
+        with self._lock:
+            if fr._cancelled or fr.done or fr._req is not req \
+                    or req.phase != "handoff":
+                # The stream moved on without us: cancel reached it, or
+                # the donor died and _failover orphaned it (fr._req is
+                # None / a survivor's record now). Nothing to migrate.
+                self._settle_handoff(donor, req, t0, "dropped")
+                return True
+            spec = self._build_handoff_spec(req)
+        pbase = int(np.asarray(record["pbase"])) if "pbase" in record else 0
+        acceptors = self._ordered(include_draining=True, role="decode")
+        if not acceptors:
+            return self._handoff_fallback(fr, donor, req, t0)
+        for acc in acceptors:
+            placed = self._try_acceptor(acc, donor, fr, req, record,
+                                        spec, pbase, t0)
+            if placed is not None:
+                return placed
+        return False
+
+    def _try_acceptor(self, acc, donor, fr, req, record, spec, pbase, t0):
+        """Try ONE decode-capable acceptor. Returns True (settled on
+        this acceptor, or found cancelled at commit), or None — this
+        acceptor cannot take it (dead, slot pool full, or it lacks the
+        aliased prefix span even after a ship attempt) and the caller
+        moves to the next candidate.
+
+        Lock choreography: adopt + commit both run under acc.lock, with
+        the fleet lock nested for the commit — the same rep.lock ->
+        self._lock order every other path uses. Holding acc.lock across
+        the commit closes the window where the acceptor could fail
+        between adoption and the handle pointing at it; holding
+        self._lock for the phase re-check serializes against cancel()'s
+        handoff branch, so a cancel either lands before (we abort the
+        freshly adopted copy) or after (it retries against the new
+        owner) — never half-way."""
+        shipped = False
+        while True:
+            committed = None
+            with acc.lock:
+                if acc.failed:
+                    return None
+                if not acc.engine._scheduler.free_slot_ids():
+                    return None  # full right now — not this acceptor
+                new_req = acc.engine.adopt_handoff(spec, record)
+                if new_req is not None:
+                    with self._lock:
+                        if fr._cancelled or fr._req is not req \
+                                or req.phase != "handoff":
+                            acc.engine.cancel(new_req)
+                            committed = False
+                        else:
+                            if req.first_token_time is not None and \
+                                    fr._first_token_time is None:
+                                fr._first_token_time = req.first_token_time
+                            fr._prior.extend(
+                                int(t) for t in req.tokens)
+                            fr._req = new_req
+                            fr.replica_id = acc.rid
+                            committed = True
+            if committed is not None:
+                self._settle_handoff(
+                    donor, req, t0,
+                    "adopted" if committed else "dropped")
+                if committed:
+                    acc.wake.set()
+                return True
+            if shipped or pbase <= 0:
+                return None
+            # adopt_handoff had a free slot but refused: the record
+            # aliases a prefix span this acceptor's store does not
+            # hold. Ship the row from the donor (the PR 11 affinity
+            # transport — int8 codes as-is) and retry once.
+            shipped = True
+            if not self._ship_prefix(donor, acc, spec["prompt"], pbase):
+                return None
+
+    def _ship_prefix(self, donor, acc, prompt, pbase):
+        """Move the aliased prefix row ahead of a handoff: the captured
+        record's private plane only covers positions past ``pbase``, so
+        the acceptor must hold the same prefix content to alias. The
+        donor still holds the row — the migrating request's pin is not
+        released until finish_handoff. Donor and acceptor locks taken
+        SEQUENTIALLY, never nested (same rule as _maybe_adopt)."""
+        toks = [int(t) for t in np.asarray(prompt).reshape(-1)[:pbase]]
+        with donor.lock:
+            if donor.failed:
+                return False
+            exported = donor.engine.export_prefix(toks)
+        if exported is None:
+            return False
+        matched, prec = exported
+        with acc.lock:
+            if acc.failed:
+                return False
+            ok = acc.engine.adopt_prefix(matched, prec)
+        if ok and self._directory is not None:
+            self._directory.add(acc.rid, matched)
+        return ok
+
+    def _settle_handoff(self, donor, req, t0, outcome):
+        """Donor-side epilogue for one settled migration: forget the
+        scheduler record and unpin the request's prefix row; a real
+        adoption also observes the capture->adopt latency on the
+        DONOR's histogram (the donor owns the migration's clock), a
+        fallback counts on the donor's bank. Safe on a failed donor —
+        everything here is host-side bookkeeping."""
+        with donor.lock:
+            donor.engine.finish_handoff(req)
+            if outcome == "adopted":
+                donor.engine._handoff_latency_hist.observe(
+                    time.time() - t0)
+            elif outcome == "fallback":
+                donor.engine.counters["handoff_fallbacks"] += 1
+
+    def _handoff_fallback(self, fr, donor, req, t0):
+        """No decode-capable replica is alive: degrade every surviving
+        prefill replica to effective-mixed (capture OFF — a re-prefilled
+        stream must COMPLETE there, not bounce straight back into the
+        pump) and re-prefill this stream through the normal orphan path
+        on any survivor. Zero lost, bit-identical: the residual respec
+        is exactly the failover snapshot."""
+        for rep in self.replicas:
+            if rep.alive and rep.engine.role == "prefill":
+                with rep.lock:
+                    rep.engine._handoff_enabled = False
+        with self._lock:
+            live = not (fr._cancelled or fr.done) and fr._req is req \
+                and req.phase == "handoff"
+            if live:
+                fr._orphan()
+                self._orphans.append(fr)
+        self._settle_handoff(donor, req, t0,
+                             "fallback" if live else "dropped")
+        self._pump()
+        return True
+
     def _tick(self):
         # Non-blocking: whichever thread hits the window boundary first
         # closes it; everyone else skips rather than queueing up.
@@ -490,13 +786,23 @@ class ServingFleet(object):
 
     # ------------------------------------------------------------- submit
 
-    def _ordered(self, include_draining=False, match=None):
+    def _ordered(self, include_draining=False, match=None, role=None):
         views = [rep for rep in self.replicas
                  if rep.alive and (rep.engine.health in
                                    ("healthy", "degraded")
                                    or include_draining)]
+        # Role eligibility (disaggregated fleets): a view qualifies for
+        # ``role`` work if it holds that role or is mixed. The router
+        # SKIPS ineligible views before scoring — no score, no rng draw
+        # — so role plumbing leaves an all-mixed fleet's seeded
+        # tie-break sequence untouched (role=None passes no mask at
+        # all, the historical call).
+        eligible = None
+        if role is not None:
+            eligible = [rep.engine.role in (role, "mixed")
+                        for rep in views]
         if not match:
-            return self.router.order(views)
+            return self.router.order(views, eligible=eligible)
         # Prefix affinity: matched depth over the prefix plane length,
         # zeroed below min_prefix_len (the acceptor's on_admit probe
         # would not alias a shorter span anyway). Scoring happens in
@@ -508,7 +814,7 @@ class ServingFleet(object):
         for rep in views:
             d = match.get(rep.rid, 0)
             affinity.append(min(d, plen) / plen if d >= minp else 0.0)
-        return self.router.order(views, affinity)
+        return self.router.order(views, affinity, eligible=eligible)
 
     def _match_prefix(self, prompt):
         """Directory longest-match for one prompt: {replica_id: depth},
@@ -592,7 +898,13 @@ class ServingFleet(object):
         if self._orphans:
             self._pump()
         match = self._match_prefix(prompt)
-        candidates = self._ordered(match=match)
+        role = "prefill" if self._disagg else None
+        candidates = self._ordered(match=match, role=role)
+        if not candidates and role is not None:
+            # Every prefill-capable replica is gone: route to ANY
+            # survivor — zero-lost beats role purity (a decode-role
+            # survivor completes the stream locally; it never captures).
+            candidates = self._ordered(match=match)
         if not candidates:
             if any(rep.alive for rep in self.replicas):
                 raise EngineDraining(
@@ -676,6 +988,17 @@ class ServingFleet(object):
                 if fr.replica_id != rep_id or fr._req is None:
                     continue  # failover moved it — retry
                 if rep.alive:
+                    if fr._req.phase == "handoff":
+                        # Mid-migration: serialize with the pump's
+                        # commit (self._lock nests under rep.lock —
+                        # the allowed order). Either we cancel first
+                        # and the pump's re-check aborts the adopted
+                        # copy, or the pump committed first and the
+                        # ownership re-read sends us to the acceptor.
+                        with self._lock:
+                            if fr.replica_id != rep_id:
+                                continue  # pump won — retry there
+                            return rep.engine.cancel(fr._req)
                     return rep.engine.cancel(fr._req)
                 # Dead owner, failover not yet run: host-side cancel
                 # only (the scheduler record is durable; the pool is
@@ -772,6 +1095,8 @@ class ServingFleet(object):
         FleetRequest handles / harvest(), so this returns []."""
         if self._orphans:
             self._pump()
+        if self._handoffs.pending:
+            self._pump_handoffs()
         if self._started:
             time.sleep(self._poll_s)
             self._tick()
@@ -783,10 +1108,11 @@ class ServingFleet(object):
 
     @property
     def idle(self):
-        """True when nothing is queued, running, or orphaned anywhere —
-        dead replicas excluded (their live work was failed over; what
-        remains in their schedulers is history)."""
-        if self._orphans:
+        """True when nothing is queued, running, orphaned, or
+        mid-handoff anywhere — dead replicas excluded (their live work
+        was failed over; what remains in their schedulers is
+        history)."""
+        if self._orphans or self._handoffs.pending:
             return False
         return all(rep.failed or rep.engine.idle for rep in self.replicas)
 
@@ -796,6 +1122,8 @@ class ServingFleet(object):
             if self._started:
                 if self._orphans:
                     self._pump()
+                if self._handoffs.pending:
+                    self._pump_handoffs()
                 time.sleep(self._poll_s)
             else:
                 self.step()
@@ -953,7 +1281,8 @@ class ServingFleet(object):
                      "requests_replayed", "deadline_sheds", "step_stalls",
                      "faults_injected", "prefix_hits", "prefix_misses",
                      "prefix_adoptions", "prefix_bytes_shipped",
-                     "affinity_routed"):
+                     "affinity_routed", "handoffs", "handoffs_in",
+                     "handoff_fallbacks", "handoff_bytes_shipped"):
             if name in self.counters:
                 agg[name] = self.counters[name]
         agg.update({
@@ -962,6 +1291,8 @@ class ServingFleet(object):
             "health": self.health,
             "failovers": self.failovers,
             "orphans": len(self._orphans),
+            "roles": {rep.rid: rep.engine.role for rep in self.replicas},
+            "pending_handoffs": len(self._handoffs.pending),
             "breaker_states": {rep.rid: rep.breaker.state
                                for rep in self.replicas},
         })

@@ -40,6 +40,7 @@ from deepspeed_tpu.inference.kv_hierarchy.offload import (  # noqa: F401
     HostSwapStore,
     capture_prefix_row,
     capture_slot,
+    capture_slots,
     pick_swap_victim,
     record_nbytes,
     restore_prefix_row,

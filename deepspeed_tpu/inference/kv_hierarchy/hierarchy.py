@@ -164,6 +164,22 @@ class KVHierarchy(object):
         self.counters["prefix_inserts"] += 1
         return pool
 
+    def on_handoff_in(self, req, pbase):
+        """Acceptor-side handoff hook: the migrated record aliases a
+        shared-prefix span of ``pbase`` positions, and the engine already
+        verified (under the same lock) that THIS replica's trie holds a
+        row covering it. Pin that row for the adopted request and record
+        the attachment so byte accounting and release stay truthful.
+        Returns the local row id the record's ``pid`` must be patched to.
+        Deliberately does NOT count a hit or miss — the admission that
+        earned those stats happened on the donor; re-counting here would
+        double-book the fleet-wide hit rate."""
+        row, depth = self.store.lookup([int(t) for t in req.prompt])
+        assert row is not None and depth >= pbase, (row, depth, pbase)
+        self.store.acquire(row, req.rid)
+        self._attach_len[req.rid] = pbase
+        return row
+
     def on_release(self, req):
         """Completion/cancel hook: drop the refcount pin, any pending
         insert, and any host swap record."""

@@ -57,6 +57,32 @@ def capture_slot(pool, slot):
     return jax.device_get(arrs)
 
 
+def capture_slots(pool, slots):
+    """Snapshot SEVERAL slots to host memory in ONE batched transfer;
+    returns one record per slot, each restore_slot-compatible.
+
+    The disaggregated handoff path's transport: every request whose
+    prompt finishes in the same engine step ships together, mirroring
+    ``harvest_snapshot``'s one-transfer-per-chunk discipline — N
+    migrations cost one device round-trip, not N. Slices use a gather
+    along the slot axis so the device sees a single fancy-index read
+    per pool entry; the per-slot split happens host-side after the one
+    ``jax.device_get``."""
+    idx = jnp.asarray([int(s) for s in slots], jnp.int32)
+    arrs = {}
+    for name, arr in pool.items():
+        if name in _PREFIX_PLANE_KEYS:
+            continue  # shared prefix planes stay resident
+        if name in _PLANE_KEYS:
+            arrs[name] = arr[:, idx]
+        else:
+            arrs[name] = arr[idx]
+    host = jax.device_get(arrs)
+    return [{name: (val[:, i] if name in _PLANE_KEYS else val[i])
+             for name, val in host.items()}
+            for i in range(len(slots))]
+
+
 def restore_slot(pool, slot, record):
     """Write a captured record into slot ``slot``; returns the new pool."""
     slot = int(slot)

@@ -177,7 +177,7 @@ class Router(object):
             load = float("inf")
         return load
 
-    def order(self, views, affinity=None):
+    def order(self, views, affinity=None, eligible=None):
         """Views sorted best-first by score; EXACT score ties break by
         the seeded rng (draws happen in input order, so equal inputs +
         equal seed = equal output, run after run).
@@ -191,14 +191,25 @@ class Router(object):
         but a dead replica stays inf (affinity never resurrects it) and
         one rng draw per view still happens in input order, so the
         seeded tie-break sequence is unchanged from affinity-free
-        ordering."""
-        if affinity is None:
-            decorated = [(self.score(v), self._rng.random(), i, v)
-                         for i, v in enumerate(views)]
-        else:
-            decorated = [
-                (self.score(v) - AFFINITY_WEIGHT * float(a),
-                 self._rng.random(), i, v)
-                for i, (v, a) in enumerate(zip(views, affinity))]
+        ordering.
+
+        ``eligible`` (optional) is a sequence of bools aligned with
+        ``views`` — role eligibility in a disaggregated fleet (a new
+        prompt cannot land on a decode-role replica, a handoff cannot
+        land on a prefill-role one). Ineligible views are SKIPPED
+        OUTRIGHT: no score computation, no rng draw, absent from the
+        result — not scored-then-filtered, which would advance the
+        seeded tie-break stream and make an all-``mixed`` fleet route
+        differently just because role plumbing exists. With every view
+        eligible (or ``eligible=None``) the draw sequence is
+        bit-for-bit the historical one."""
+        decorated = []
+        for i, v in enumerate(views):
+            if eligible is not None and not eligible[i]:
+                continue
+            s = self.score(v)
+            if affinity is not None:
+                s -= AFFINITY_WEIGHT * float(affinity[i])
+            decorated.append((s, self._rng.random(), i, v))
         decorated.sort(key=lambda t: t[:3])
         return [v for _, _, _, v in decorated]

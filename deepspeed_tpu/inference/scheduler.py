@@ -16,6 +16,17 @@ Request phases: ``queued -> prefilling -> decoding -> done`` (or
 a request's deadline passes before admission). The legacy whole-prompt
 prefill path passes through ``prefilling`` for exactly one engine step.
 
+Disaggregated serving (inference/fleet.py) adds one more live phase:
+``handoff`` — the request finished prefill on a prefill-role replica,
+left its slot (the slot's device state was captured to a host record),
+and is mid-migration to a decode replica. It is slotless here exactly
+like ``swapped``, but its destination is another scheduler entirely:
+``finish_handoff`` forgets it once the acceptor's record (or a
+re-prefill fallback) owns the stream. Deadlines never shed a handoff —
+expiry is QUEUE-side only, and a handoff was admitted long ago
+("admitted work always finishes"); cancel() reaches it like any live
+phase.
+
 Recovery (docs/RESILIENCE.md) adds one extra move: after a fatal step
 error the engine calls ``requeue_running()`` — every in-flight request
 returns to the FRONT of the queue in rid (= admission) order, to be
@@ -143,6 +154,12 @@ class Scheduler(object):
         # (kv_hierarchy.offload). Insertion order IS swap-out order, so
         # next_swap_in() resumes the longest-waiting session first.
         self.swapped = {}
+        # rid -> Request in the ``handoff`` phase: prefill finished, slot
+        # captured and freed, stream mid-migration to another replica
+        # (disaggregated serving — module docstring). Still this
+        # scheduler's responsibility (``idle`` counts it) until
+        # finish_handoff hands the durable truth to the new owner.
+        self.handoff = {}
         self.completed = {}         # rid -> Request (incl. cancelled)
         self._ids = itertools.count()
         # Telemetry is strictly additive: tracer gets lifecycle spans,
@@ -331,6 +348,66 @@ class Scheduler(object):
                                 rid=req.rid, slot=slot,
                                 tokens=len(req.tokens))
 
+    # ----------------------------------------------- disaggregated handoff
+
+    def begin_handoff(self, req):
+        """Move a DECODING request out of its slot into the ``handoff``
+        phase (disaggregated serving): the prompt's final chunk landed
+        on this prefill-role replica, the engine captured the slot's
+        device state to a host record, and the stream is mid-migration
+        to a decode replica. Slotless like ``swapped``, but bound for a
+        DIFFERENT scheduler — the fleet's pump either places the record
+        on an acceptor or falls back to re-prefill, then calls
+        finish_handoff either way."""
+        assert req.phase == "decoding", req.phase
+        self.running.pop(req.slot)
+        req.slot = None
+        req.phase = "handoff"
+        self.handoff[req.rid] = req
+        if self.tracer is not None:
+            self.tracer.instant("request/handoff", tid=req.rid,
+                                rid=req.rid, tokens=len(req.tokens))
+
+    def finish_handoff(self, req):
+        """The migration settled — adopted by a peer replica, or fallen
+        back to re-prefill elsewhere: drop the request from this
+        scheduler's books entirely (NOT completed(); the new owner's
+        record is the durable truth now and stamps the terminal
+        phase)."""
+        self.handoff.pop(req.rid, None)
+
+    def adopt(self, prompt, max_new_tokens, temperature, top_k,
+              eos_token_id, seed, slot, spec=False, deadline=None,
+              submit_time=None, admit_time=None, first_token_time=None):
+        """ACCEPTOR-side constructor: install a request migrated from a
+        prefill-role peer straight into ``slot`` in the ``decoding``
+        phase — it never queues here and never rides the prefill lane
+        (the restored KV record IS its prefill). ``prompt`` is the
+        residual respec form (original prompt + tokens already emitted
+        on the donor) so a later recovery replay on THIS replica is
+        bit-identical, exactly like an orphan re-submission. The donor's
+        submit/admit/first-token stamps carry over so queue-wait and
+        TTFT are observed exactly once, on the replica where they
+        actually happened."""
+        assert slot not in self.running, slot
+        req = Request(next(self._ids), prompt, max_new_tokens, temperature,
+                      top_k, eos_token_id, seed, spec, deadline=deadline)
+        if submit_time is not None:
+            req.submit_time = submit_time
+            req.last_touch = submit_time
+        req.admit_time = admit_time if admit_time is not None \
+            else req.submit_time
+        req.first_token_time = first_token_time
+        req.cursor = int(prompt.size)
+        req.slot = slot
+        req.phase = "decoding"
+        self.running[slot] = req
+        if self.tracer is not None:
+            self.tracer.instant("request/handoff_in", tid=req.rid,
+                                rid=req.rid, slot=slot,
+                                prompt_tokens=int(prompt.size))
+        return req
+
     # -------------------------------------------------------- completion
 
     def complete(self, slot):
@@ -367,6 +444,13 @@ class Scheduler(object):
         elif req.phase == "swapped":
             self.swapped.pop(req.rid)  # slotless; host record is the
             # engine's to drop (hierarchy on_release)
+        elif req.phase == "handoff":
+            # Slotless and already off the device (the slot was captured
+            # and deactivated at begin_handoff) — host bookkeeping only.
+            # pop() tolerates a record the pump already claimed: the
+            # placement commit re-checks the phase under the fleet lock
+            # and aborts on the adopted copy (fleet._pump_handoffs).
+            self.handoff.pop(req.rid, None)
         else:
             self.running.pop(req.slot)
             req.slot = None
@@ -397,7 +481,11 @@ class Scheduler(object):
         order. SWAPPED sessions requeue too: their host swap records
         described a pool that no longer exists (the engine drops them
         via hierarchy reset), but the request records are the durable
-        truth and replay rebuilds the stream bit-identically."""
+        truth and replay rebuilds the stream bit-identically.
+        HANDOFF requests deliberately stay put: their device state was
+        already captured to host records that survive the pool rebuild
+        untouched — the fleet's pump migrates or falls back regardless
+        of what happens to this replica's pool."""
         reqs = sorted(list(self.running.values())
                       + list(self.swapped.values()), key=lambda r: r.rid)
         self.running.clear()
@@ -417,7 +505,7 @@ class Scheduler(object):
     @property
     def idle(self):
         return (not self.queue and not self.running
-                and not self.swapped)
+                and not self.swapped and not self.handoff)
 
     def occupancy(self):
         return len(self.running) / float(self.num_slots)

@@ -24,10 +24,12 @@ import math
 
 from deepspeed_tpu.loadgen import slo as slo_mod
 
-SCHEMA_VERSION = 3  # v2: + chaos section (recovery/requests_lost) and
+SCHEMA_VERSION = 4  # v2: + chaos section (recovery/requests_lost) and
 # per-sample terminal phase. v3: + prefix section (hit rate, bytes
-# shipped by cross-replica adoption, affinity-routed count) — additive,
-# but comparisons across versions deserve the gate's schema caveat.
+# shipped by cross-replica adoption, affinity-routed count). v4: +
+# disagg section (prefill->decode handoff counts, fallbacks, bytes
+# shipped) — each additive, but comparisons across versions deserve the
+# gate's schema caveat.
 
 # Gate polarity: which direction is a REGRESSION for each report
 # metric. Lower-is-better latencies only fail when they grow;
@@ -143,6 +145,24 @@ def _prefix_section(result):
     }
 
 
+def _disagg_section(result):
+    """Disaggregated-serving facts for the run (stable schema — a
+    single engine or all-mixed fleet shows zeros). The counters are run
+    DELTAS the runner read back: ``handoffs`` prompts captured off
+    prefill replicas and migrated, ``handoff_fallbacks`` the re-prefills
+    taken when no decode-capable replica could adopt (each one is a
+    resilience event, not a loss — the stream still completed), and the
+    KV bytes the handoff records shipped host-side. The disagg A/B's
+    headline lives in the aggregate ITL percentiles; this section is
+    the attribution that the traffic really migrated."""
+    return {
+        "handoffs": int(getattr(result, "handoffs", 0)),
+        "handoff_fallbacks": int(getattr(result, "handoff_fallbacks", 0)),
+        "handoff_bytes_shipped": int(
+            getattr(result, "handoff_bytes_shipped", 0)),
+    }
+
+
 def build_report(spec, result, slo, chips=1, platform=None, extra=None):
     """Fold one RunResult into the report document.
 
@@ -187,6 +207,7 @@ def build_report(spec, result, slo, chips=1, platform=None, extra=None):
         "slo": slo_section,
         "chaos": _chaos_section(result, slo),
         "prefix": _prefix_section(result),
+        "disagg": _disagg_section(result),
         "timeseries": {
             "window_seconds": result.collector.window_seconds,
             "windows_total": result.collector._idx,

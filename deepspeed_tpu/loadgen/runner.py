@@ -66,6 +66,13 @@ class RunResult:
     prefix_misses: int = 0
     prefix_bytes_shipped: int = 0
     affinity_routed: int = 0
+    # Disaggregated-serving facts (zero on single engines and all-mixed
+    # fleets): counter deltas across this run — prompts captured off
+    # prefill replicas, re-prefill fallbacks (no decode-capable
+    # survivor), and the KV bytes the handoff records shipped.
+    handoffs: int = 0
+    handoff_fallbacks: int = 0
+    handoff_bytes_shipped: int = 0
 
 
 def _sample_row(lr, req):
@@ -158,7 +165,8 @@ class SustainedRunner(object):
         faults_at_start = _counter("faults_injected")
         prefix_at_start = {n: _counter(n) for n in (
             "prefix_hits", "prefix_misses", "prefix_bytes_shipped",
-            "affinity_routed")}
+            "affinity_routed", "handoffs", "handoff_fallbacks",
+            "handoff_bytes_shipped")}
         while i < len(pending) or not self.engine.idle:
             now = self._clock() - t0
             if (self.chaos_plan is not None and injector is None
@@ -238,4 +246,10 @@ class SustainedRunner(object):
             prefix_bytes_shipped=_counter("prefix_bytes_shipped")
             - prefix_at_start["prefix_bytes_shipped"],
             affinity_routed=_counter("affinity_routed")
-            - prefix_at_start["affinity_routed"])
+            - prefix_at_start["affinity_routed"],
+            handoffs=_counter("handoffs")
+            - prefix_at_start["handoffs"],
+            handoff_fallbacks=_counter("handoff_fallbacks")
+            - prefix_at_start["handoff_fallbacks"],
+            handoff_bytes_shipped=_counter("handoff_bytes_shipped")
+            - prefix_at_start["handoff_bytes_shipped"])
