@@ -1759,6 +1759,185 @@ def main_disagg(smoke=False, disagg=True):
     return 0
 
 
+def _measure_frontdoor(smoke=False, frontdoor=True):
+    """`bench.py --frontdoor-smoke`: the SLO front door's priority A/B
+    as a benchmark artifact.
+
+    ONE mixed-tenant workload (loadgen WorkloadSpec.mixed_tenants): per
+    tenant, a steady interactive Poisson stream plus a batch ramp that
+    saturates the engine by the tail of the run. ``frontdoor=True``
+    drives it through inference.FrontDoor — priority dispatch, batch
+    gating, preemption into the swapped phase — and ASSERTS the
+    acceptance bar: interactive p99 TTFT within its budget, zero lost,
+    compile_count still 1. ``frontdoor=False`` (`--no-frontdoor`) runs
+    the SAME offered load straight into the engine's FIFO (metric
+    suffixed ``_nofrontdoor`` so the series never mix) with no TTFT
+    assertion — interactive queues behind the batch backlog, and the
+    per-class numbers stamped in ``extra`` show the budget violation
+    the A/B exists to show."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.inference import (
+        FrontDoor,
+        FrontDoorConfig,
+        PriorityClass,
+        TenantPolicy,
+    )
+    from deepspeed_tpu.loadgen import (
+        SLO,
+        SustainedRunner,
+        WorkloadSpec,
+        build_report,
+    )
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu" and not smoke
+    if on_tpu:
+        cfg = GPT2Config.gpt2_medium(dropout=0.0, use_flash_attention=True)
+        serve_cfg = {"max_slots": 16, "max_len": 1024, "chunk_size": 16,
+                     "max_queue": 256, "host_offload": True}
+        spec = WorkloadSpec.mixed_tenants(
+            tenants=("tenant_a", "tenant_b"), seed=29,
+            interactive_rate=4.0, interactive_n=24,
+            batch_rate=24.0, batch_ramp_from=4.0, batch_n=48,
+            prompt_dist="lognormal", prompt_mean=64, prompt_max=256,
+            output_dist="lognormal", output_mean=64, output_min=16,
+            output_max=128, vocab_size=cfg.vocab_size)
+        window_s = 2.0
+        budget_ms = 1500.0
+    else:
+        cfg = GPT2Config.tiny(dropout=0.0, use_flash_attention=False)
+        # TWO slots and a deep queue: the batch ramp buries the FIFO,
+        # which is exactly the head-of-line effect the front door must
+        # beat (and the --no-frontdoor A/B must show).
+        serve_cfg = {"max_slots": 2, "max_len": 64, "chunk_size": 4,
+                     "max_queue": 256, "host_offload": True,
+                     "swap_slots": 8}
+        # Batch floods in almost at once (flat "ramp" at 200/s) with
+        # long outputs — several seconds of work for two slots — while
+        # interactive trickles across that whole saturation window.
+        spec = WorkloadSpec.mixed_tenants(
+            tenants=("tenant_a", "tenant_b"), seed=29,
+            interactive_rate=2.0, interactive_n=8,
+            batch_rate=200.0, batch_ramp_from=200.0, batch_n=60,
+            prompt_dist="lognormal", prompt_mean=6, prompt_min=2,
+            prompt_max=10,
+            interactive_overrides={"output_dist": "fixed",
+                                   "output_mean": 3},
+            batch_overrides={"output_dist": "fixed", "output_mean": 32},
+            vocab_size=cfg.vocab_size)
+        window_s = 0.25
+        # The acceptance budget: generous against CPU/CI jitter for the
+        # front-door run (priority dispatch holds interactive to a slot
+        # wait, well under a second), but far below the multi-second
+        # head-of-line delay the batch flood inflicts on bare FIFO.
+        budget_ms = 1000.0
+
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(0, cfg.vocab_size, size=(2, 16))
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(init_ids))["params"]
+    engine = deepspeed.init_inference(
+        model=model, params=params, config={"inference": serve_cfg})
+    engine.generate([np.arange(1, 9, dtype=np.int32)], max_new_tokens=2)
+    engine.recompile_detector.mark_warm()
+    engine.metrics(reset=True)
+
+    if frontdoor:
+        target = FrontDoor(engine, FrontDoorConfig(
+            classes=(
+                PriorityClass("interactive", ttft_budget_ms=budget_ms,
+                              weight=4.0, shed_on_budget=False),
+                PriorityClass("batch", weight=1.0, preemptible=True),
+            ),
+            tenants=(TenantPolicy("tenant_a"), TenantPolicy("tenant_b")),
+            # Keep the engine-side FIFO shallow: batch only flows while
+            # a hypothetical interactive arrival would still see ~1/4
+            # of its budget — the rest of the flood waits in the lanes.
+            batch_headroom=0.25,
+        ))
+    else:
+        target = engine
+
+    slo = SLO(ttft_p99_ms=budget_ms, itl_p99_ms=None)
+    class_slos = {
+        "interactive": SLO(ttft_p99_ms=budget_ms, itl_p99_ms=None),
+        "batch": SLO(ttft_p99_ms=None, itl_p99_ms=None),
+    }
+    runner = SustainedRunner(target, spec, window_seconds=window_s,
+                             max_steps=500_000)
+    result = runner.run()
+    report = build_report(
+        spec, result, slo, platform=platform, class_slos=class_slos,
+        extra={"git_hash": _git_state(),
+               "model": "gpt2_medium" if on_tpu else "gpt2_tiny",
+               "serve_cfg": dict(serve_cfg),
+               "frontdoor": bool(frontdoor),
+               "budget_ms": budget_ms})
+    fd_classes = report["frontdoor"]["classes"]
+    inter = fd_classes.get("interactive", {})
+    batch = fd_classes.get("batch", {})
+    post = target.metrics() if frontdoor else engine.metrics()
+    compile_count = post["compile_count"]
+
+    assert result.requests_lost == 0, \
+        "{} accepted request(s) lost".format(result.requests_lost)
+    assert compile_count == 1, \
+        "front-door run recompiled: {}".format(compile_count)
+    assert batch.get("completed", 0) > 0, "batch stream never completed"
+    if frontdoor:
+        # The acceptance bar: interactive held its budget WHILE the
+        # batch ramp saturated the engine. The --no-frontdoor A/B runs
+        # the same stream and is expected to blow through it.
+        p99 = inter.get("ttft_p99_ms")
+        assert p99 is not None and p99 <= budget_ms, \
+            "interactive p99 TTFT {}ms exceeds the {}ms budget with " \
+            "the front door ON".format(p99, budget_ms)
+
+    suffix = "" if frontdoor else "_nofrontdoor"
+    extra = {
+        "platform": platform,
+        "frontdoor": bool(frontdoor),
+        "budget_ms": budget_ms,
+        "interactive_ttft_p99_ms": inter.get("ttft_p99_ms"),
+        "interactive_itl_p99_ms": inter.get("itl_p99_ms"),
+        "interactive_attainment": inter.get("slo_attainment"),
+        "batch_ttft_p99_ms": batch.get("ttft_p99_ms"),
+        "batch_itl_p99_ms": batch.get("itl_p99_ms"),
+        "sheds_by_reason": report["frontdoor"]["sheds_by_reason"],
+        "preemptions": int(result.preemptions),
+        "preempt_resumes": int(result.preempt_resumes),
+        "requests_lost": int(result.requests_lost),
+        "compile_count": int(compile_count),
+        "note": "per-class SLO A/B vs the _nofrontdoor suffix at the "
+                "same offered load; docs/INFERENCE.md 'Streaming, "
+                "SLO-aware front door' section is the contract",
+        "frontdoor_report": report["frontdoor"],
+    }
+    if frontdoor:
+        extra["frontdoor_metrics"] = post.get("frontdoor")
+    return {
+        "metric": "gpt2_{}_frontdoor{}_interactive_ttft_p99_ms".format(
+            "355m" if on_tpu else "tiny_smoke", suffix),
+        "value": (round(inter["ttft_p99_ms"], 3)
+                  if inter.get("ttft_p99_ms") is not None else None),
+        "unit": "ms",
+        "vs_baseline": None,
+        "extra": extra,
+    }
+
+
+def main_frontdoor(smoke=False, frontdoor=True):
+    if not smoke:
+        _require_tpu_or_exit()
+    _emit(_measure_frontdoor(smoke=smoke, frontdoor=frontdoor))
+    return 0
+
+
 def main_bert(sparse=False):
     _require_tpu_or_exit()
     _measure_bert(sparse=sparse, steps=12)
@@ -1822,6 +2001,17 @@ def _dispatch(argv):
     prefix_affinity = "--no-prefix-affinity" not in argv
     disagg_ab = "--disagg" in argv or "--no-disagg" in argv
     disagg_on = "--no-disagg" not in argv
+    # --frontdoor / --no-frontdoor: the SLO front-door A/B. --frontdoor
+    # drives the mixed-tenant workload through inference.FrontDoor and
+    # asserts the interactive TTFT budget; --no-frontdoor runs the SAME
+    # offered load straight into the engine FIFO (metric suffixed
+    # _nofrontdoor so the series never mix) with no budget assertion.
+    frontdoor_on = "--no-frontdoor" not in argv
+    if "--frontdoor-smoke" in argv:
+        return main_frontdoor(smoke=True, frontdoor=frontdoor_on)
+    if "--frontdoor" in argv or "--no-frontdoor" in argv:
+        return main_frontdoor(smoke="--smoke" in argv,
+                              frontdoor=frontdoor_on)
     if "--fleet-smoke" in argv:
         if disagg_ab:
             return main_disagg(smoke=True, disagg=disagg_on)

@@ -84,4 +84,5 @@ DETERMINISM_MODULES = (
 # Classes the THREADRACE rule always checks, manifest or not (a class
 # that also DEFINES ``_THREAD_OWNED`` opts in wherever it lives).
 THREAD_CHECKED_CLASSES = ("InferenceEngine", "ServingFleet",
-                          "PrefixDirectory", "HandoffPump")
+                          "PrefixDirectory", "HandoffPump",
+                          "FrontDoor", "TokenStream")

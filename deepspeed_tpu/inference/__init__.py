@@ -19,6 +19,13 @@ from deepspeed_tpu.inference.fleet import (  # noqa: F401
     FleetRequest,
     ServingFleet,
 )
+from deepspeed_tpu.inference.frontdoor import (  # noqa: F401
+    FrontDoor,
+    FrontDoorConfig,
+    PriorityClass,
+    TenantPolicy,
+    TokenStream,
+)
 from deepspeed_tpu.inference.kv_hierarchy import (  # noqa: F401
     HierarchySpec,
     KVHierarchy,

@@ -588,6 +588,11 @@ class InferenceEngine(object):
             # forever on a flat-pool engine.
             "prefix_hits", "prefix_misses", "prefix_inserts",
             "prefix_evictions", "swap_outs", "swap_ins",
+            # Front-door priority preemption (inference/frontdoor):
+            # batch sessions parked in the swapped phase to protect an
+            # interactive TTFT budget, and their later resumes. Zero
+            # forever without a front door driving this engine.
+            "preemptions", "preempt_resumes",
             # Fleet-prefix counters (docs/INFERENCE.md): planes adopted
             # from peer replicas, host bytes those shipments moved, and
             # requests the fleet routed here FOR a cached prefix. The
@@ -621,6 +626,13 @@ class InferenceEngine(object):
         # One record per recovery: absolute t_start/t_end, duration,
         # error, replay count — the chaos loadgen's SLO-impact windows.
         self.recovery_log = []
+        # Front-door priority preemption: rids HELD in the swapped
+        # phase (resume-first swap-in skips them until released), and
+        # rids whose eventual swap-in should count as a preempt_resume
+        # rather than a plain swap_in. Mutated in place only — same
+        # external serialization as every engine entry.
+        self._preempt_hold = set()
+        self._preempted_rids = set()
         # Live gauges: sampled at read (scrape) time, zero hot-path cost.
         self.telemetry.gauge("queue_depth").set_fn(
             lambda: len(self._scheduler.queue))
@@ -811,6 +823,12 @@ class InferenceEngine(object):
             self._hier.reset()
         replayed = self._scheduler.requeue_running()
         self._replay_requests(replayed)
+        # Preemption ledgers described swapped sessions that just moved
+        # to the queue: clear them — the replay re-prefills through
+        # admission, not through a swap-in, so no hold applies and no
+        # preempt_resume will be (or should be) counted.
+        self._preempt_hold.clear()
+        self._preempted_rids.clear()
         self.counters["recoveries"] += 1
         self.counters["requests_replayed"] += len(replayed)
         t1 = time.time()
@@ -831,7 +849,7 @@ class InferenceEngine(object):
 
     def submit(self, prompt, max_new_tokens=None, temperature=0.0,
                top_k=None, eos_token_id=None, seed=0, spec_decode=None,
-               deadline_ms=None):
+               deadline_ms=None, priority=None, tenant=None):
         """Queue one request; returns its Request handle. Raises
         scheduler.QueueFull past ``max_queue`` pending requests
         (backpressure — structured with queue_depth + a retry_after_s
@@ -845,7 +863,10 @@ class InferenceEngine(object):
         expiry budget — a request still QUEUED deadline_ms after submit
         is shed as ``expired`` (a ``deadline_sheds`` count) instead of
         wasting a slot on an answer nobody is waiting for; once
-        admitted, it always finishes."""
+        admitted, it always finishes. ``priority``/``tenant``: front-door
+        class and tenant tags (inference/frontdoor) — pure metadata here
+        except that a QueueFull raised for a tagged submission carries
+        that class's OWN retry_after_s hint."""
         if not self._health.accepting:
             if self._health.state == "dead":
                 raise EngineDeadError(
@@ -857,7 +878,8 @@ class InferenceEngine(object):
                 "(undrain() reopens)")
         if self._injector is not None and self._injector.admission_blocked():
             raise self._scheduler.queue_full_error(
-                "admission blocked by injected fault (admission_block)")
+                "admission blocked by injected fault (admission_block)",
+                priority=priority, tenant=tenant)
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -893,7 +915,7 @@ class InferenceEngine(object):
                 -1 if eos_token_id is None else int(eos_token_id),
                 int(seed),
                 spec=self._spec is not None and spec_decode is not False,
-                deadline=deadline)
+                deadline=deadline, priority=priority, tenant=tenant)
         except QueueFull as exc:
             raise self._augment_queue_full(exc) from None
 
@@ -940,6 +962,9 @@ class InferenceEngine(object):
             # Unpin any prefix row and drop a swapped session's host
             # record (a swapped cancel has no slot to deactivate).
             self._hier.on_release(req)
+        # A cancelled session cannot stay in the preemption ledgers.
+        self._preempt_hold.discard(req.rid)
+        self._preempted_rids.discard(req.rid)
         if was_decoding:
             # Freeze the slot on device so the decode lane stops burning
             # its rows (a prefilling slot was never active — nothing to
@@ -1068,7 +1093,7 @@ class InferenceEngine(object):
         resumed rids (this round's swap-out exclusion set)."""
         resumed = []
         while True:
-            req = self._scheduler.next_swap_in()
+            req = self._scheduler.next_swap_in(skip=self._preempt_hold)
             if req is None:
                 break
             free = self._scheduler.free_slot_ids()
@@ -1080,6 +1105,9 @@ class InferenceEngine(object):
             self._pool = restore_slot(self._pool, slot, record)
             self._scheduler.swap_in(req, slot)
             self.counters["swap_ins"] += 1
+            if req.rid in self._preempted_rids:
+                self._preempted_rids.discard(req.rid)
+                self.counters["preempt_resumes"] += 1
             self._swap_in_hist.observe(time.time() - t0)
             resumed.append(req.rid)
         return resumed
@@ -1123,6 +1151,58 @@ class InferenceEngine(object):
         self._swap_out_hist.observe(self._last_swap_out_s)
         if self._scheduler.queue:
             self._admit()
+
+    # ------------------------------------------- front-door preemption
+
+    def preempt(self, req):
+        """PRIORITY preemption (inference/frontdoor): park a DECODING
+        request in the ``swapped`` phase — the exact swap-out move the
+        capacity policy makes, so the session resumes bit-identically —
+        and HOLD it there: resume-first swap-in skips held rids until
+        ``release_preempted()``, because an unheld victim would be
+        swapped straight back in on the very next step. Requires host
+        offload (the swapped phase IS the kv_hierarchy's parking spot)
+        and swap-store room; returns False when the request is not
+        parkable (wrong phase, no hierarchy, store full) — the caller
+        sheds or defers instead. Crash-safe for free: a held swapped
+        session rides ``requeue_running()`` like any other, and
+        ``_recover`` clears the holds (the replayed stream re-earns its
+        slot through the queue)."""
+        hier = self._hier
+        if hier is None or not hier.spec.offload:
+            return False
+        if req.phase != "decoding" or req.slot is None:
+            return False
+        if not hier.swap_capacity_left():
+            return False
+        t0 = time.time()
+        record = capture_slot(self._pool, req.slot)
+        hier.swap_store.put(req.rid, record)
+        self._pool = dict(self._pool, active=self._pool["active"]
+                          .at[req.slot].set(False))
+        self._scheduler.swap_out(req)
+        self.counters["swap_outs"] += 1
+        self.counters["preemptions"] += 1
+        self._preempt_hold.add(req.rid)
+        self._preempted_rids.add(req.rid)
+        self._last_swap_out_s = time.time() - t0
+        self._swap_out_hist.observe(self._last_swap_out_s)
+        return True
+
+    def release_preempted(self, req=None):
+        """Lift the preemption hold on ``req`` (None: on every held
+        session): the next ``_swap_in_ready()`` round may resume it —
+        counted as a ``preempt_resumes`` — as soon as a slot frees.
+        Idempotent; a rid that already resumed or finished is a no-op."""
+        if req is None:
+            self._preempt_hold.clear()
+        else:
+            self._preempt_hold.discard(req.rid)
+
+    def preempted_held(self):
+        """rids currently parked by preempt() and not yet released —
+        the front door's view of its own parking lot."""
+        return frozenset(self._preempt_hold)
 
     # ------------------------------------------- cross-replica adoption
 
@@ -1255,7 +1335,8 @@ class InferenceEngine(object):
             spec["top_k"], spec["eos_token_id"], spec["seed"], slot,
             spec=spec["spec"], deadline=spec["deadline"],
             submit_time=spec["submit_time"], admit_time=spec["admit_time"],
-            first_token_time=spec["first_token_time"])
+            first_token_time=spec["first_token_time"],
+            priority=spec.get("priority"), tenant=spec.get("tenant"))
         if pbase > 0:
             # Re-pin under the same lock the peek ran under — nothing
             # can have moved between them. The donor's pid named a row
@@ -1600,6 +1681,9 @@ class InferenceEngine(object):
             "requests_replayed": c.window("requests_replayed"),
             "deadline_sheds": c.window("deadline_sheds"),
             "step_stalls": c.window("step_stalls"),
+            # Front-door preemption traffic (zero without a front door).
+            "preemptions": c.window("preemptions"),
+            "preempt_resumes": c.window("preempt_resumes"),
             # Disaggregated serving (inference/fleet.py): this engine's
             # side of the KV-plane handoff traffic. ``handoffs`` counts
             # donor captures (prefill role), ``handoffs_in`` acceptor

@@ -111,7 +111,7 @@ from deepspeed_tpu.inference.resilience import (
     EngineDraining,
 )
 from deepspeed_tpu.inference.router import CircuitBreaker, Router
-from deepspeed_tpu.inference.scheduler import QueueFull
+from deepspeed_tpu.inference.scheduler import QueueFull, RETRY_AFTER_CAP_S
 from deepspeed_tpu.parallel import mesh as mesh_lib
 from deepspeed_tpu.telemetry import (
     MergedRegistry,
@@ -218,6 +218,8 @@ class FleetRequest(object):
             "seed": req.seed,
             "spec": req.spec,
             "deadline": req.deadline,
+            "priority": req.priority,
+            "tenant": req.tenant,
         }
         self._req = None
         self.replica_id = None
@@ -384,7 +386,8 @@ class ServingFleet(object):
     def __init__(self, model, params, n_replicas=2, config=None, seed=0,
                  window_seconds=1.0, window_capacity=512, start=True,
                  breaker_factory=None, idle_wait_s=0.01, poll_s=0.002,
-                 prefix_affinity=None, roles=None):
+                 prefix_affinity=None, roles=None,
+                 latency_classes=("interactive",)):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1, got "
                              "{}".format(n_replicas))
@@ -447,6 +450,15 @@ class ServingFleet(object):
         self.prefix_affinity = bool(prefix_affinity)
         self._directory = PrefixDirectory() if self.prefix_affinity \
             else None
+        # Class-aware placement (inference/frontdoor): submissions
+        # tagged with one of these priority classes are routed only to
+        # the SHALLOWEST live queues (minimum queue depth among the
+        # otherwise-eligible views) — a latency-class request must not
+        # land behind a replica's batch backlog when an emptier peer
+        # exists. Untagged and non-latency traffic takes the historical
+        # router order untouched (eligibility is an ineligible-view
+        # SKIP, so the seeded tie-break sequence is preserved).
+        self._latency_classes = frozenset(latency_classes or ())
         self.telemetry = MergedRegistry(
             {r.rid: r.engine.telemetry for r in self.replicas})
         self.collector = TimeseriesCollector(
@@ -632,6 +644,8 @@ class ServingFleet(object):
             "submit_time": req.submit_time,
             "admit_time": req.admit_time,
             "first_token_time": req.first_token_time,
+            "priority": req.priority,
+            "tenant": req.tenant,
         }
 
     def _place_handoff(self, fr, donor, req, record, t0):
@@ -786,7 +800,8 @@ class ServingFleet(object):
 
     # ------------------------------------------------------------- submit
 
-    def _ordered(self, include_draining=False, match=None, role=None):
+    def _ordered(self, include_draining=False, match=None, role=None,
+                 shallow=False):
         views = [rep for rep in self.replicas
                  if rep.alive and (rep.engine.health in
                                    ("healthy", "degraded")
@@ -801,6 +816,18 @@ class ServingFleet(object):
         if role is not None:
             eligible = [rep.engine.role in (role, "mixed")
                         for rep in views]
+        # Latency-class placement: restrict to the minimum queue depth
+        # among the views still eligible — same SKIP mechanism as
+        # roles, so untagged traffic's rng sequence is untouched.
+        if shallow and views:
+            depths = [rep.queue_depth for rep in views]
+            base = eligible if eligible is not None \
+                else [True] * len(views)
+            pool = [d for d, e in zip(depths, base) if e]
+            if pool:
+                dmin = min(pool)
+                eligible = [e and d <= dmin
+                            for d, e in zip(depths, base)]
         if not match:
             return self.router.order(views, eligible=eligible)
         # Prefix affinity: matched depth over the prefix plane length,
@@ -899,7 +926,8 @@ class ServingFleet(object):
             self._pump()
         match = self._match_prefix(prompt)
         role = "prefill" if self._disagg else None
-        candidates = self._ordered(match=match, role=role)
+        shallow = kw.get("priority") in self._latency_classes
+        candidates = self._ordered(match=match, role=role, shallow=shallow)
         if not candidates and role is not None:
             # Every prefill-capable replica is gone: route to ANY
             # survivor — zero-lost beats role purity (a decode-role
@@ -939,14 +967,64 @@ class ServingFleet(object):
                     self._requests[fr.fid] = fr
             rep.wake.set()
             return fr
+        # MIN across per-replica hints (each already class-aware — the
+        # engines stamped the submitting class's own completions rate),
+        # clamped to the same ceiling a single scheduler enforces:
+        # breaker backoff hints are arbitrary floats and must not leak
+        # an unclamped wait upstream. priority/tenant ride the fleet
+        # error so the front door's per-class payload survives routing.
         retry = min(hints) if hints else None
+        if retry is not None:
+            retry = round(min(max(retry, 0.0), RETRY_AFTER_CAP_S), 4)
         raise QueueFull(
             "fleet: all {} candidate replica(s) rejected the request "
             "(open breaker or full queue){}".format(
                 len(candidates),
                 "" if retry is None else
-                " (retry_after_s hint: {})".format(round(retry, 4))),
-            queue_depth=depth, retry_after_s=retry, replica_id=None)
+                " (retry_after_s hint: {})".format(retry)),
+            queue_depth=depth, retry_after_s=retry, replica_id=None,
+            priority=kw.get("priority"), tenant=kw.get("tenant"),
+            reason="queue_full")
+
+    # --------------------------------------------------------- preemption
+
+    def preempt(self, fr):
+        """Park ``fr`` on its owning replica (engine.preempt: swapped
+        phase + hold) — the fleet half of front-door priority
+        preemption. Returns False when the request is not parkable
+        right now (mid-failover, wrong phase, owner dead, or no swap
+        room); retries internally if a failover moves it between the
+        ownership read and the replica lock, exactly like cancel()."""
+        while True:
+            rep_id = fr.replica_id
+            if rep_id is None:
+                return False  # mid-failover; replay re-queues it anyway
+            rep = self.replicas[rep_id]
+            with rep.lock:
+                if fr.replica_id != rep_id or fr._req is None:
+                    continue  # failover moved it — retry
+                if not rep.alive:
+                    return False
+                return rep.engine.preempt(fr._req)
+
+    def release_preempted(self, fr):
+        """Lift the preemption hold on ``fr`` so its replica's
+        resume-first swap-in can pick it back up. Returns False when
+        the request is mid-failover or its owner died (the hold died
+        with the engine's ledgers — replay re-queues the stream)."""
+        while True:
+            rep_id = fr.replica_id
+            if rep_id is None:
+                return False
+            rep = self.replicas[rep_id]
+            with rep.lock:
+                if fr.replica_id != rep_id or fr._req is None:
+                    continue
+                if not rep.alive:
+                    return False
+                rep.engine.release_preempted(fr._req)
+            rep.wake.set()
+            return True
 
     # ------------------------------------------------------------ harvest
 
@@ -1072,7 +1150,9 @@ class ServingFleet(object):
                         spec["prompt"], spec["max_new_tokens"],
                         spec["temperature"], spec["top_k"],
                         spec["eos_token_id"], spec["seed"],
-                        spec=spec["spec"], deadline=spec["deadline"])
+                        spec=spec["spec"], deadline=spec["deadline"],
+                        priority=spec.get("priority"),
+                        tenant=spec.get("tenant"))
                 except QueueFull:
                     continue
                 with self._lock:
@@ -1282,7 +1362,8 @@ class ServingFleet(object):
                      "faults_injected", "prefix_hits", "prefix_misses",
                      "prefix_adoptions", "prefix_bytes_shipped",
                      "affinity_routed", "handoffs", "handoffs_in",
-                     "handoff_fallbacks", "handoff_bytes_shipped"):
+                     "handoff_fallbacks", "handoff_bytes_shipped",
+                     "preemptions", "preempt_resumes"):
             if name in self.counters:
                 agg[name] = self.counters[name]
         agg.update({

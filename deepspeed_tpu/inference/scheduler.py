@@ -69,15 +69,27 @@ class QueueFull(RuntimeError):
     hierarchy can free a slot by swapping an idle session to host RAM"
     (engine._augment_queue_full sets it and arms the swap): the caller
     should retry after ``retry_after_s`` instead of failing over —
-    capacity is about to appear on THIS replica."""
+    capacity is about to appear on THIS replica.
+
+    ``priority``/``tenant`` stamp the rejected submission's class and
+    tenant (None for untagged traffic) so upstream backoff is
+    CLASS-AWARE: the hint for a priority-tagged shed comes from that
+    class's own completions rate, not the global one. ``reason``
+    classifies the shed (``queue_full`` here; the front door adds
+    ``slo``/``deadline``/``rate_limit``/``tenant_queue``) so shed
+    accounting can be split by cause, not just counted."""
 
     def __init__(self, message, queue_depth=None, retry_after_s=None,
-                 replica_id=None, swap_eligible=False):
+                 replica_id=None, swap_eligible=False, priority=None,
+                 tenant=None, reason=None):
         super().__init__(message)
         self.queue_depth = queue_depth
         self.retry_after_s = retry_after_s
         self.replica_id = replica_id
         self.swap_eligible = swap_eligible
+        self.priority = priority
+        self.tenant = tenant
+        self.reason = reason
 
 
 class Request(object):
@@ -86,10 +98,12 @@ class Request(object):
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature", "top_k",
                  "eos_token_id", "seed", "spec", "tokens", "slot", "phase",
                  "cursor", "submit_time", "admit_time", "first_token_time",
-                 "finish_time", "deadline", "replays", "last_touch")
+                 "finish_time", "deadline", "replays", "last_touch",
+                 "priority", "tenant")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature, top_k,
-                 eos_token_id, seed, spec=False, deadline=None):
+                 eos_token_id, seed, spec=False, deadline=None,
+                 priority=None, tenant=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -130,6 +144,13 @@ class Request(object):
         # means an idle session whose slot is cheap to park
         # (kv_hierarchy.offload.pick_swap_victim).
         self.last_touch = self.submit_time
+        # Front-door annotations (inference/frontdoor): the priority
+        # class and tenant this request was admitted under. Pure
+        # metadata to the scheduler EXCEPT that completions feed the
+        # per-class retry_after_s estimator; None for the legacy
+        # untagged surface, which behaves exactly as before.
+        self.priority = priority
+        self.tenant = tenant
 
     @property
     def done(self):
@@ -172,46 +193,69 @@ class Scheduler(object):
                                 if registry is not None else None)
         # Recent completion timestamps — the retry_after_s estimator's
         # evidence. Bounded: backpressure hints need recency, not
-        # history.
+        # history. ``_finish_by_class`` keeps the same evidence split by
+        # priority class so a class-tagged shed gets a hint from ITS
+        # completions rate — batch backpressure (slow, long outputs)
+        # must not inflate the interactive hint.
         self._finish_times = collections.deque(maxlen=32)
+        self._finish_by_class = {}
         # True once any queued request carries a deadline: admissions()
         # skips the expiry scan entirely on deadline-free workloads.
         self._has_deadlines = False
 
     # ------------------------------------------------------------ submit
 
-    def retry_after_s(self):
-        """Backpressure hint: estimated seconds until one queue position
-        frees, from the recent completions rate (None before two recent
-        completions exist — no rate, no guess)."""
-        if len(self._finish_times) < 2:
+    @staticmethod
+    def _rate_hint(times):
+        """1/rate over a completion-timestamp deque, clamped to
+        [0, RETRY_AFTER_CAP_S]; None below two observations (no rate,
+        no guess)."""
+        if times is None or len(times) < 2:
             return None
-        span = self._finish_times[-1] - self._finish_times[0]
+        span = times[-1] - times[0]
         if span <= 0:
             return None
-        rate = (len(self._finish_times) - 1) / span
+        rate = (len(times) - 1) / span
         return round(min(max(1.0 / rate, 0.0), RETRY_AFTER_CAP_S), 4)
 
-    def queue_full_error(self, reason=None):
+    def retry_after_s(self, priority=None):
+        """Backpressure hint: estimated seconds until one queue position
+        frees, from the recent completions rate (None before two recent
+        completions exist). CLASS-AWARE: with ``priority`` the estimate
+        comes from that class's own completions — an interactive shed
+        during a batch-dominated window hints at the interactive rate,
+        not the global one — falling back to the global evidence until
+        the class has two completions of its own."""
+        if priority is not None:
+            hint = self._rate_hint(self._finish_by_class.get(priority))
+            if hint is not None:
+                return hint
+        return self._rate_hint(self._finish_times)
+
+    def queue_full_error(self, reason=None, priority=None, tenant=None):
         """The structured QueueFull for the CURRENT queue state — also
         built by the engine for admission-pressure sheds (injected
         faults, drain) so every shed carries the same backpressure
-        fields."""
+        fields. ``priority`` selects the class-aware hint and is stamped
+        on the error along with ``tenant``."""
         depth = len(self.queue)
-        hint = self.retry_after_s()
+        hint = self.retry_after_s(priority)
         msg = reason or ("inference queue is full ({} pending); retry "
                          "later or raise inference.max_queue".format(depth))
         if hint is not None:
             msg += " (retry_after_s hint: {})".format(hint)
         return QueueFull(msg, queue_depth=depth, retry_after_s=hint,
-                         replica_id=self.replica_id)
+                         replica_id=self.replica_id, priority=priority,
+                         tenant=tenant, reason="queue_full")
 
     def submit(self, prompt, max_new_tokens, temperature, top_k,
-               eos_token_id, seed, spec=False, deadline=None):
+               eos_token_id, seed, spec=False, deadline=None,
+               priority=None, tenant=None):
         if len(self.queue) >= self.max_queue:
-            raise self.queue_full_error()
+            raise self.queue_full_error(priority=priority, tenant=tenant)
         req = Request(next(self._ids), prompt, max_new_tokens, temperature,
-                      top_k, eos_token_id, seed, spec, deadline=deadline)
+                      top_k, eos_token_id, seed, spec, deadline=deadline,
+                      priority=priority, tenant=tenant)
         if deadline is not None:
             self._has_deadlines = True
         self.queue.append(req)
@@ -327,12 +371,18 @@ class Scheduler(object):
             self.tracer.instant("request/swapped_out", tid=req.rid,
                                 rid=req.rid, tokens=len(req.tokens))
 
-    def next_swap_in(self):
+    def next_swap_in(self, skip=()):
         """The longest-swapped session, or None — resume-first fairness:
         a swapped session outranks fresh queue admissions for the next
         free slot, so swaps time-slice the slot set instead of starving
-        whoever lost the first eviction."""
-        return next(iter(self.swapped.values()), None)
+        whoever lost the first eviction. ``skip`` (rids) excludes
+        sessions deliberately HELD in the swapped phase — the front
+        door's priority preemption parks batch work there and must not
+        see it swapped straight back in on the next step."""
+        for rid, req in self.swapped.items():
+            if rid not in skip:
+                return req
+        return None
 
     def swap_in(self, req, slot):
         """Resume a swapped request into ``slot`` (need not be the slot
@@ -378,7 +428,8 @@ class Scheduler(object):
 
     def adopt(self, prompt, max_new_tokens, temperature, top_k,
               eos_token_id, seed, slot, spec=False, deadline=None,
-              submit_time=None, admit_time=None, first_token_time=None):
+              submit_time=None, admit_time=None, first_token_time=None,
+              priority=None, tenant=None):
         """ACCEPTOR-side constructor: install a request migrated from a
         prefill-role peer straight into ``slot`` in the ``decoding``
         phase — it never queues here and never rides the prefill lane
@@ -391,7 +442,8 @@ class Scheduler(object):
         actually happened."""
         assert slot not in self.running, slot
         req = Request(next(self._ids), prompt, max_new_tokens, temperature,
-                      top_k, eos_token_id, seed, spec, deadline=deadline)
+                      top_k, eos_token_id, seed, spec, deadline=deadline,
+                      priority=priority, tenant=tenant)
         if submit_time is not None:
             req.submit_time = submit_time
             req.last_touch = submit_time
@@ -419,6 +471,10 @@ class Scheduler(object):
         req.slot = None
         self.completed[req.rid] = req
         self._finish_times.append(req.finish_time)
+        if req.priority is not None:
+            self._finish_by_class.setdefault(
+                req.priority,
+                collections.deque(maxlen=32)).append(req.finish_time)
         if self.tracer is not None:
             if req.first_token_time is not None:
                 self.tracer.span("request/decode", req.first_token_time,

@@ -36,6 +36,7 @@ from deepspeed_tpu.loadgen.runner import RunResult, SustainedRunner
 from deepspeed_tpu.loadgen.slo import SLO, evaluate
 from deepspeed_tpu.loadgen.workload import (
     LoadRequest,
+    MixedWorkload,
     WorkloadSpec,
     replay_trace,
     save_trace,
@@ -44,6 +45,7 @@ from deepspeed_tpu.telemetry import TimeseriesCollector
 
 __all__ = [
     "LoadRequest",
+    "MixedWorkload",
     "WorkloadSpec",
     "replay_trace",
     "save_trace",

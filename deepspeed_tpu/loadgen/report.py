@@ -24,12 +24,14 @@ import math
 
 from deepspeed_tpu.loadgen import slo as slo_mod
 
-SCHEMA_VERSION = 4  # v2: + chaos section (recovery/requests_lost) and
+SCHEMA_VERSION = 5  # v2: + chaos section (recovery/requests_lost) and
 # per-sample terminal phase. v3: + prefix section (hit rate, bytes
 # shipped by cross-replica adoption, affinity-routed count). v4: +
 # disagg section (prefill->decode handoff counts, fallbacks, bytes
-# shipped) — each additive, but comparisons across versions deserve the
-# gate's schema caveat.
+# shipped). v5: + frontdoor section (per-class SLO attainment, sheds by
+# reason, per-tenant tallies, preemption counts) and per-sample
+# priority/tenant/shed_reason keys — each additive, but comparisons
+# across versions deserve the gate's schema caveat.
 
 # Gate polarity: which direction is a REGRESSION for each report
 # metric. Lower-is-better latencies only fail when they grow;
@@ -163,14 +165,74 @@ def _disagg_section(result):
     }
 
 
-def build_report(spec, result, slo, chips=1, platform=None, extra=None):
+def _frontdoor_section(result, slo, class_slos=None):
+    """Front-door facts for the run (stable schema — an untagged run
+    shows one ``untagged`` class and zero preemptions). Samples group
+    by their ``priority`` tag; each class is judged against its OWN
+    budget from ``class_slos`` (name -> SLO) with the run-level SLO as
+    the fallback — per-class attainment under per-class budgets is the
+    number the mixed-tenant acceptance gate pins. ``sheds_by_reason``
+    folds the structured QueueFull reasons (rate_limit /
+    frontdoor_full / deadline / slo / queue_full); preemption counts
+    are the runner's counter deltas."""
+    class_slos = class_slos or {}
+    by_class = {}
+    for s in result.samples:
+        by_class.setdefault(s.get("priority") or "untagged",
+                            []).append(s)
+    classes = {}
+    for cname, rows in sorted(by_class.items()):
+        budget = class_slos.get(cname, slo)
+        ttfts = [r["ttft_s"] * 1e3 for r in rows
+                 if r.get("ttft_s") is not None]
+        itls = [r["itl_s"] * 1e3 for r in rows
+                if r.get("itl_s") is not None]
+        classes[cname] = {
+            "requests": len(rows),
+            "completed": sum(1 for r in rows if r["completed"]),
+            "shed": sum(1 for r in rows if r["shed"]),
+            "budgets": budget.to_json(),
+            "slo_attainment": (sum(1 for r in rows if budget.meets(r))
+                               / len(rows)) if rows else None,
+            "ttft_p50_ms": _percentile(ttfts, 50),
+            "ttft_p99_ms": _percentile(ttfts, 99),
+            "itl_p50_ms": _percentile(itls, 50),
+            "itl_p99_ms": _percentile(itls, 99),
+        }
+    sheds = {}
+    tenants = {}
+    for s in result.samples:
+        if s["shed"]:
+            reason = s.get("shed_reason") or "queue_full"
+            sheds[reason] = sheds.get(reason, 0) + 1
+        tname = s.get("tenant")
+        if tname is not None:
+            row = tenants.setdefault(
+                tname, {"requests": 0, "completed": 0, "shed": 0,
+                        "tokens_out": 0})
+            row["requests"] += 1
+            row["completed"] += 1 if s["completed"] else 0
+            row["shed"] += 1 if s["shed"] else 0
+            row["tokens_out"] += s["tokens_out"]
+    return {
+        "classes": classes,
+        "sheds_by_reason": sheds,
+        "tenants": tenants,
+        "preemptions": int(getattr(result, "preemptions", 0)),
+        "preempt_resumes": int(getattr(result, "preempt_resumes", 0)),
+    }
+
+
+def build_report(spec, result, slo, chips=1, platform=None, extra=None,
+                 class_slos=None):
     """Fold one RunResult into the report document.
 
     Aggregates come from the per-request samples (exact, not windowed);
     the ``windows`` rows carry the curves. ``extra`` merges caller
     provenance (git hash, config digest, probe state) into
     ``context`` — the gate reads context to warn when two reports were
-    never comparable to begin with."""
+    never comparable to begin with. ``class_slos`` (name -> SLO) gives
+    each priority class its own budget in the frontdoor section."""
     t0 = result.windows[0]["t_start"] if result.windows else 0.0
     ttfts = [s["ttft_s"] * 1e3 for s in result.samples
              if s["ttft_s"] is not None]
@@ -208,6 +270,7 @@ def build_report(spec, result, slo, chips=1, platform=None, extra=None):
         "chaos": _chaos_section(result, slo),
         "prefix": _prefix_section(result),
         "disagg": _disagg_section(result),
+        "frontdoor": _frontdoor_section(result, slo, class_slos),
         "timeseries": {
             "window_seconds": result.collector.window_seconds,
             "windows_total": result.collector._idx,

@@ -73,18 +73,30 @@ class RunResult:
     handoffs: int = 0
     handoff_fallbacks: int = 0
     handoff_bytes_shipped: int = 0
+    # Front-door facts (zero without one): counter deltas across this
+    # run — batch sessions parked in the swapped phase to protect a
+    # latency budget, and their later bit-identical resumes.
+    preemptions: int = 0
+    preempt_resumes: int = 0
 
 
-def _sample_row(lr, req):
+def _sample_row(lr, req, shed_reason=None):
     """One per-request record from the scheduler Request's timestamp
     trail (submit/first-token/finish are stamped by the engine at
-    harvest time — the runner only reads them back)."""
+    harvest time — the runner only reads them back). ``priority``/
+    ``tenant`` come from the workload tags (the request echoes them on
+    tagged surfaces; the tags are authoritative for shed rows, which
+    never got a request); ``shed_reason`` is the structured QueueFull
+    reason for shed rows (None on legacy untagged sheds)."""
     row = {
         "arrival_s": lr.arrival_s,
         "prompt_tokens": int(lr.prompt.size),
         "max_new_tokens": int(lr.max_new_tokens),
         "shed": req is None,
+        "shed_reason": shed_reason,
         "rid": None if req is None else req.rid,
+        "priority": getattr(lr, "priority", None),
+        "tenant": getattr(lr, "tenant", None),
         "ttft_s": None,
         "e2e_s": None,
         "itl_s": None,
@@ -149,7 +161,7 @@ class SustainedRunner(object):
     def run(self):
         pending = self.spec.requests() if hasattr(self.spec, "requests") \
             else list(self.spec)
-        handles = []          # (LoadRequest, Request-or-None) in order
+        handles = []   # (LoadRequest, Request-or-None, shed_reason) rows
         t0 = self._clock()
         self.collector.start(t0)
         i, steps, shed = 0, 0, 0
@@ -166,7 +178,7 @@ class SustainedRunner(object):
         prefix_at_start = {n: _counter(n) for n in (
             "prefix_hits", "prefix_misses", "prefix_bytes_shipped",
             "affinity_routed", "handoffs", "handoff_fallbacks",
-            "handoff_bytes_shipped")}
+            "handoff_bytes_shipped", "preemptions", "preempt_resumes")}
         while i < len(pending) or not self.engine.idle:
             now = self._clock() - t0
             if (self.chaos_plan is not None and injector is None
@@ -180,13 +192,22 @@ class SustainedRunner(object):
             # loop: the schedule, not the backlog, decides.
             while i < len(pending) and pending[i].arrival_s <= now:
                 lr = pending[i]
+                kw = {}
+                # Tagged workloads ride the front-door surface; the
+                # legacy untagged call shape stays byte-identical.
+                if getattr(lr, "priority", None) is not None:
+                    kw["priority"] = lr.priority
+                if getattr(lr, "tenant", None) is not None:
+                    kw["tenant"] = lr.tenant
                 try:
                     handles.append((lr, self.engine.submit(
                         lr.prompt, max_new_tokens=lr.max_new_tokens,
-                        temperature=lr.temperature, seed=lr.seed)))
-                except QueueFull:
+                        temperature=lr.temperature, seed=lr.seed,
+                        **kw), None))
+                except QueueFull as exc:
                     shed += 1
-                    handles.append((lr, None))
+                    handles.append((lr, None,
+                                    getattr(exc, "reason", None)))
                 i += 1
             if self.engine.idle:
                 # Nothing in flight: sleep to the next arrival, but
@@ -203,12 +224,13 @@ class SustainedRunner(object):
                         "sustained run exceeded max_steps={} with {} "
                         "requests outstanding — engine wedged?".format(
                             self.max_steps, len(pending) - i +
-                            sum(1 for _, r in handles
+                            sum(1 for _, r, _ in handles
                                 if r is not None and not r.done)))
             self.collector.tick()
         self.collector.sample()   # flush the tail window
         wall = self._clock() - t0
-        samples = [_sample_row(lr, req) for lr, req in handles]
+        samples = [_sample_row(lr, req, reason)
+                   for lr, req, reason in handles]
         # Recovery intervals from this run only, converted to run-
         # relative seconds (the engine stamps time.time(); chaos runs
         # use the real clock — module docstring).
@@ -222,7 +244,7 @@ class SustainedRunner(object):
         # The recovery invariant's bottom line: every ACCEPTED request
         # must reach a terminal phase — done, or deliberately shed
         # (expired / cancelled). Anything else was lost by the engine.
-        lost = sum(1 for _, r in handles
+        lost = sum(1 for _, r, _ in handles
                    if r is not None and r.phase not in
                    ("done", "expired", "cancelled"))
         return RunResult(
@@ -230,7 +252,7 @@ class SustainedRunner(object):
             windows=self.collector.windows(),
             collector=self.collector,
             wall_s=wall,
-            submitted=sum(1 for _, r in handles if r is not None),
+            submitted=sum(1 for _, r, _ in handles if r is not None),
             completed=sum(1 for s in samples if s["completed"]),
             shed=shed,
             tokens_out=sum(s["tokens_out"] for s in samples),
@@ -252,4 +274,8 @@ class SustainedRunner(object):
             handoff_fallbacks=_counter("handoff_fallbacks")
             - prefix_at_start["handoff_fallbacks"],
             handoff_bytes_shipped=_counter("handoff_bytes_shipped")
-            - prefix_at_start["handoff_bytes_shipped"])
+            - prefix_at_start["handoff_bytes_shipped"],
+            preemptions=_counter("preemptions")
+            - prefix_at_start["preemptions"],
+            preempt_resumes=_counter("preempt_resumes")
+            - prefix_at_start["preempt_resumes"])

@@ -35,13 +35,19 @@ LENGTH_DISTS = ("fixed", "lognormal", "zipf")
 
 @dataclasses.dataclass(eq=False)
 class LoadRequest:
-    """One scheduled request: WHEN it arrives and WHAT it asks for."""
+    """One scheduled request: WHEN it arrives and WHAT it asks for.
+
+    ``priority``/``tenant`` are front-door tags (inference/frontdoor):
+    None keeps the legacy untagged stream and the runner's legacy
+    submit() call shape byte-for-byte."""
 
     arrival_s: float
     prompt: np.ndarray          # int32 token ids
     max_new_tokens: int
     temperature: float = 0.0
     seed: int = 0
+    priority: Optional[str] = None
+    tenant: Optional[str] = None
 
 
 def _lengths(rng, dist, n, mean, sigma, zipf_a, lo, hi):
@@ -268,6 +274,78 @@ class WorkloadSpec:
         )
         params.update(overrides)
         return cls(**params)
+
+    @classmethod
+    def mixed_tenants(cls, tenants=("tenant_a", "tenant_b"), seed=0,
+                      interactive_rate=4.0, interactive_n=16,
+                      batch_rate=8.0, batch_ramp_from=1.0, batch_n=16,
+                      interactive_overrides=None, batch_overrides=None,
+                      **common):
+        """The front-door acceptance workload: per tenant, an
+        INTERACTIVE Poisson stream (steady chat-shaped arrivals) plus a
+        BATCH ramp (offered load climbing from ``batch_ramp_from`` to
+        ``batch_rate`` — by the tail of the run batch alone saturates
+        the target, which is exactly when the interactive TTFT budget
+        is earned or lost). Returns a MixedWorkload whose ``requests()``
+        merges every sub-stream arrival-sorted with each row tagged
+        ``priority``/``tenant``.
+
+        Determinism: each sub-spec's seed derives from (``seed``, tenant
+        index, class) by fixed arithmetic — same seed, same tenants,
+        same streams, forever. ``common`` overrides apply to every
+        sub-spec (geometry knobs: prompt/output bounds, vocab);
+        ``interactive_overrides``/``batch_overrides`` apply per class."""
+        parts = []
+        for i, tenant in enumerate(tenants):
+            ikw = dict(
+                arrival="poisson", rate=interactive_rate,
+                n_requests=interactive_n,
+                seed=seed * 1000 + i * 2 + 1)
+            ikw.update(common)
+            ikw.update(interactive_overrides or {})
+            parts.append((tenant, "interactive", cls(**ikw)))
+            bkw = dict(
+                arrival="ramp", rate=batch_rate,
+                ramp_from=batch_ramp_from, n_requests=batch_n,
+                seed=seed * 1000 + i * 2 + 2)
+            bkw.update(common)
+            bkw.update(batch_overrides or {})
+            parts.append((tenant, "batch", cls(**bkw)))
+        return MixedWorkload(parts, seed=seed)
+
+
+@dataclasses.dataclass(frozen=True)
+class MixedWorkload:
+    """Several tagged WorkloadSpec sub-streams merged into one arrival-
+    sorted stream. Duck-types the WorkloadSpec surface the runner and
+    report use (``requests()``, ``to_json()``, ``seed``)."""
+
+    parts: tuple   # ((tenant, priority, WorkloadSpec), ...)
+    seed: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "parts", tuple(self.parts))
+        if not self.parts:
+            raise ValueError("MixedWorkload needs at least one part")
+
+    def requests(self):
+        rows = []
+        for tenant, priority, spec in self.parts:
+            for r in spec.requests():
+                r.priority = priority
+                r.tenant = tenant
+                rows.append(r)
+        rows.sort(key=lambda r: r.arrival_s)
+        return rows
+
+    def to_json(self):
+        return {
+            "mixed_tenants": [
+                {"tenant": tenant, "priority": priority,
+                 "spec": spec.to_json()}
+                for tenant, priority, spec in self.parts],
+            "seed": self.seed,
+        }
 
 
 # ------------------------------------------------------------------ trace

@@ -1,0 +1,272 @@
+"""Front-door preemption x resilience (docs/RESILIENCE.md, front-door
+section).
+
+The contract under test:
+1. PREEMPT/RESUME — ``engine.preempt()`` parks a decoding request in
+   the ``swapped`` phase and HOLDS it (resume-first swap-in skips held
+   rids); ``release_preempted()`` lifts the hold and the session
+   resumes BIT-IDENTICALLY (positional fold_in rng — the stream never
+   depends on when or where it ran).
+2. PREEMPT x CRASH — a fatal step fault while a request sits preempted
+   loses nothing: recovery clears the holds, the parked stream replays
+   through the queue, and every request finishes bit-identical to the
+   fault-free reference.
+3. PREEMPT x REPLICA KILL — same invariant one layer up: the preempted
+   request's owner dies; the durable record re-submits to a survivor
+   and completes bit-identically, zero lost.
+4. MID-STREAM FAILOVER — a TokenStream being consumed when its replica
+   dies resumes from its integer cursor over the MONOTONE FleetRequest
+   token list: the consumed stream equals the fault-free reference
+   exactly — no token duplicated, none dropped.
+"""
+
+import pytest
+
+from deepspeed_tpu.inference import (
+    Fault,
+    FaultPlan,
+    FrontDoor,
+    FrontDoorConfig,
+    PriorityClass,
+)
+from tests.unit.test_chunked_prefill import (
+    engine_of,
+    make_model,
+    prompts_of,
+    seq_greedy,
+)
+from tests.unit.test_fleet import fleet_of
+
+# One deterministic model for the module (init dominates wall time).
+_MODEL = {}
+
+
+def _shared_model():
+    if "m" not in _MODEL:
+        _MODEL["m"] = make_model()
+    return _MODEL["m"]
+
+
+def _hier(model, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("host_offload", True)
+    kw.setdefault("swap_slots", 8)
+    return engine_of(model, params, **kw)
+
+
+_LENS = [6, 9, 5, 12, 7, 8]
+
+
+def _mix_kw(i):
+    # Generous decode budgets: speculative decode emits several tokens
+    # per step, so a tiny max_new can go queued->done inside ONE step
+    # and "decoding" is never observable to park against.
+    kw = {"max_new_tokens": 16 + (i % 3)}
+    if i % 2:
+        kw["temperature"] = 0.7
+        kw["seed"] = 100 + i
+    return kw
+
+
+def _step_until(target, pred, limit=800, what="condition"):
+    for _ in range(limit):
+        if pred():
+            return
+        target.step()
+    pytest.fail("never reached: " + what)
+
+
+_REF = {}
+
+
+def _reference(model, params, prompts):
+    """Fault-free single-engine oracle for the mixed stream, memoized
+    for the module (every test here compares against the same run)."""
+    if "ref" not in _REF:
+        eng = engine_of(model, params)
+        reqs = [eng.submit(p, **_mix_kw(i)) for i, p in enumerate(prompts)]
+        eng.run()
+        _REF["ref"] = [list(r.tokens) for r in reqs]
+    return _REF["ref"]
+
+
+def _fd_cfg():
+    return FrontDoorConfig(classes=(
+        PriorityClass("interactive", ttft_budget_ms=60_000.0, weight=4.0),
+        PriorityClass("batch", weight=1.0, preemptible=True),
+    ))
+
+
+# ------------------------------------------------- preempt/release resume
+
+
+def test_preempt_release_resume_bit_identical():
+    """The direct engine API: park a mid-decode request, let the rest
+    of the batch run (the hold must keep it OUT of swap-in), release,
+    and the resumed stream matches the sequential oracle bit for bit —
+    with the preemption counters ticking."""
+    cfg, model, params = _shared_model()
+    prompts = prompts_of(cfg, [6, 9, 5])
+    eng = _hier(model, params)
+    reqs = [eng.submit(p, max_new_tokens=24) for p in prompts]
+    _step_until(eng,
+                lambda: reqs[0].phase == "decoding" and reqs[0].tokens,
+                what="reqs[0] mid-decode")
+    emitted = len(reqs[0].tokens)
+    assert eng.preempt(reqs[0])
+    assert reqs[0].phase == "swapped"
+    assert reqs[0].rid in eng.preempted_held()
+    # Held means held: stepping makes progress for everyone else, but
+    # the victim stays parked however many swap-in rounds pass.
+    for _ in range(20):
+        eng.step()
+    assert reqs[0].phase == "swapped"
+    assert len(reqs[0].tokens) == emitted
+    assert not eng.idle                    # the held session keeps it live
+    eng.release_preempted(reqs[0])
+    assert eng.preempted_held() == frozenset()
+    eng.run()
+    assert all(r.phase == "done" for r in reqs)
+    for p, r in zip(prompts, reqs):
+        assert r.tokens == seq_greedy(model, params, p, 24)
+    assert eng.counters["preemptions"] == 1
+    assert eng.counters["preempt_resumes"] == 1
+    assert eng.compile_count == 1
+
+
+def test_release_all_and_unparkable_phases():
+    cfg, model, params = _shared_model()
+    eng = _hier(model, params)
+    (p,) = prompts_of(cfg, [6])
+    req = eng.submit(p, max_new_tokens=24)
+    # queued/prefilling requests are not parkable — preempt refuses.
+    assert not eng.preempt(req)
+    _step_until(eng, lambda: req.phase == "decoding", what="req decoding")
+    assert eng.preempt(req)
+    eng.release_preempted()                # None releases every hold
+    assert eng.preempted_held() == frozenset()
+    eng.run()
+    assert req.tokens == seq_greedy(model, params, p, 24)
+    # No hierarchy -> no parking spot: preempt is a clean refusal.
+    plain = engine_of(model, params, max_slots=2, host_offload=False)
+    r2 = plain.submit(p, max_new_tokens=24)
+    _step_until(plain, lambda: r2.phase == "decoding", what="r2 decoding")
+    assert not plain.preempt(r2)
+    plain.run()
+
+
+# ----------------------------------------------------- preempt x crash
+
+
+def test_preempted_request_survives_engine_crash():
+    """A fatal step fault fires while one request sits preempted in the
+    swapped phase: recovery clears the hold, the parked stream replays
+    through the queue, and EVERY request — victim included — finishes
+    bit-identical to the fault-free reference. Zero lost."""
+    cfg, model, params = _shared_model()
+    prompts = prompts_of(cfg, _LENS)
+    ref = _reference(model, params, prompts)
+    eng = _hier(model, params, max_slots=3, fault_injection=True)
+    reqs = [eng.submit(p, **_mix_kw(i)) for i, p in enumerate(prompts)]
+    _step_until(eng,
+                lambda: reqs[0].phase == "decoding" and reqs[0].tokens,
+                what="reqs[0] mid-decode")
+    assert eng.preempt(reqs[0])
+    assert reqs[0].phase == "swapped"
+    eng.inject_faults(FaultPlan(faults=(Fault("raise", step=0),)))
+    eng.run()
+    assert all(r.phase == "done" for r in reqs)          # zero lost
+    assert [list(r.tokens) for r in reqs] == ref         # bit-identical
+    assert len(eng.recovery_log) == 1
+    assert eng.preempted_held() == frozenset()           # holds cleared
+    assert eng.health == "healthy"
+    assert eng.compile_count == 1
+
+
+# ----------------------------------------- preempt x replica kill (fleet)
+
+
+def test_preempted_request_survives_replica_kill():
+    """The fleet half: a request preempted on replica 0 loses its owner.
+    The durable fleet record re-submits the stream to the survivor with
+    its residual budget and it completes bit-identically — the swapped
+    parking spot is replica-local state the failover path never needs."""
+    cfg, model, params = _shared_model()
+    prompts = prompts_of(cfg, _LENS)
+    ref = _reference(model, params, prompts)
+    fleet = fleet_of(model, params, start=False, fault_injection=True,
+                     recovery_max_retries=0, host_offload=True,
+                     swap_slots=8)
+    try:
+        frs = [fleet.submit(p, **_mix_kw(i))
+               for i, p in enumerate(prompts)]
+        victims = [fr for fr in frs if fr.replica_id == 0]
+        assert victims and len(victims) < len(frs)
+        for _ in range(300):
+            if any(fr.phase == "decoding" and fr.tokens and not fr.done
+                   for fr in victims):
+                break
+            fleet.step()
+        else:
+            pytest.fail("replica 0 never reached mid-decode")
+        victim = next(fr for fr in victims
+                      if fr.phase == "decoding" and fr.tokens)
+        assert fleet.preempt(victim)
+        assert victim.phase == "swapped"
+        fleet.inject_faults(
+            FaultPlan(faults=(Fault("raise", step=0),)), replica=0)
+        assert fleet.wait_idle(timeout_s=120.0)
+        assert all(fr.phase == "done" for fr in frs)     # zero lost
+        assert [fr.tokens for fr in frs] == ref          # bit-identical
+        assert victim.failovers >= 1
+        assert fleet.metrics()["fleet"]["health"] == "healthy"
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------- mid-stream failover
+
+
+def test_mid_stream_failover_no_duplicate_no_drop():
+    """TokenStreams being consumed when their replica dies: the per-
+    token iterator resumes over the monotone FleetRequest token list
+    and the CONSUMED stream — what the caller actually saw — equals
+    the fault-free reference exactly. No token twice, none missing,
+    survivor compile count unchanged."""
+    cfg, model, params = _shared_model()
+    prompts = prompts_of(cfg, _LENS)
+    ref = _reference(model, params, prompts)
+    fleet = fleet_of(model, params, start=False, fault_injection=True,
+                     recovery_max_retries=0, host_offload=True,
+                     swap_slots=8)
+    fd = FrontDoor(fleet, _fd_cfg())
+    try:
+        streams = [fd.stream(p, **_mix_kw(i))
+                   for i, p in enumerate(prompts)]
+        victims = [s for s in streams
+                   if s.handle._req.replica_id == 0]
+        assert victims and len(victims) < len(streams)
+        # Consume one token from every stream — each next() pumps the
+        # fleet, so every request is genuinely in flight mid-kill.
+        got = [[next(s)] for s in streams]
+        assert any(not s.handle.done for s in victims)
+        survivor_compiles = fleet.compile_counts[1]
+        fleet.inject_faults(
+            FaultPlan(faults=(Fault("raise", step=0),)), replica=0)
+        # Drain round-robin — the harshest interleaving for the cursor.
+        live = set(range(len(streams)))
+        while live:
+            for i in sorted(live):
+                try:
+                    got[i].append(next(streams[i]))
+                except StopIteration:
+                    live.discard(i)
+        assert got == ref                   # no duplicate, no drop
+        assert all(s.handle.phase == "done" for s in streams)
+        assert any(s.handle._req.failovers >= 1 for s in victims)
+        assert fleet.compile_counts[1] == survivor_compiles
+        assert fd.compile_count == sum(fleet.compile_counts.values())
+        stats = fd.metrics()["frontdoor"]["stats"]
+        assert stats["completed"] == len(prompts)
+    finally:
+        fleet.close()
