@@ -344,6 +344,41 @@ def _analysis_summary():
     return _ANALYSIS_SUMMARY
 
 
+_TRACE_SUMMARY = None
+
+
+def _note_trace(target, alerts_fired=None):
+    """Fold ``target``'s trace/alert state into the next artifact.
+
+    ``target`` is anything with ``trace_recorders()`` (engine, fleet,
+    FrontDoor); span counts, ring drops, and fired alert names (from
+    ``target.alerts`` when present, or the explicit ``alerts_fired``
+    list) are stamped into ``extra.trace_summary`` by ``_emit`` so
+    every perf artifact records what the observability plane saw while
+    the number was earned. Swallows everything — a broken tracer must
+    not cost an already-earned measurement."""
+    global _TRACE_SUMMARY
+    try:
+        spans = {}
+        dropped = 0
+        for site, rec in target.trace_recorders().items():
+            counts = rec.span_counts()
+            if counts:
+                spans[site] = sum(counts.values())
+            dropped += int(getattr(rec, "dropped", 0))
+        if alerts_fired is None:
+            alerts = getattr(target, "alerts", None)
+            alerts_fired = ([r["rule"] for r in alerts.fired()]
+                            if alerts is not None else [])
+        _TRACE_SUMMARY = {
+            "spans": spans,
+            "spans_dropped": dropped,
+            "alerts_fired": list(alerts_fired),
+        }
+    except Exception as exc:  # noqa: BLE001 — bench must not die on tracing
+        _TRACE_SUMMARY = {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def _emit(result):
     """Print the one driver-facing JSON line.
 
@@ -421,6 +456,10 @@ def _emit(result):
     # trajectory records whether the tree was contract-clean when the
     # number was earned.
     result["extra"].setdefault("analysis_findings", _analysis_summary())
+    # Observability plane state for this measurement (PR 14): span counts
+    # per recorder site, ring drops, and any SLO alerts that fired.
+    if _TRACE_SUMMARY is not None:
+        result["extra"].setdefault("trace_summary", dict(_TRACE_SUMMARY))
     # flush: under the battery/supervisor stdout is a file; a later wedge
     # must not take this already-earned result line with it.
     print(json.dumps(result), flush=True)
@@ -1045,6 +1084,7 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
         name += "_noprefixcache"
     if not host_offload:
         name += "_nohostoffload"
+    _note_trace(engine)
     return {
         "metric": name,
         "value": round(tok_per_sec, 1),
@@ -1197,9 +1237,22 @@ def _measure_sustained(smoke=False):
     engine.recompile_detector.mark_warm()
     engine.metrics(reset=True)
 
+    # SLO burn-rate alerting rides along (telemetry/alerts.py): each
+    # run's AlertManager watches the runner's own collector with the
+    # run's SLO budgets as rule budgets; every rising edge lands in
+    # RunResult.alerts_fired and the artifact's trace_summary.
+    from deepspeed_tpu.telemetry import AlertManager, default_rules
+    alert_managers = []
+
     def run_spec(spec):
         runner = SustainedRunner(engine, spec, window_seconds=window_s,
                                  max_steps=500_000)
+        runner.alerts = AlertManager(
+            runner.collector,
+            default_rules(ttft_budget_s=slo.ttft_p99_ms / 1000.0,
+                          itl_budget_s=slo.itl_p99_ms / 1000.0,
+                          queue_saturation=serve_cfg["max_queue"]))
+        alert_managers.append(runner.alerts)
         result = runner.run()
         return build_report(
             spec, result, slo, platform=platform,
@@ -1222,6 +1275,8 @@ def _measure_sustained(smoke=False):
     # is exactly 0 everywhere) — stamped so every report proves its own
     # gate is not trivially red.
     report["gate_self_check"] = regression_gate(report, report)
+    _note_trace(engine, alerts_fired=[
+        r["rule"] for m in alert_managers for r in m.fired()])
 
     agg = report["aggregate"]
     return {
@@ -1345,6 +1400,24 @@ def _measure_chaos(smoke=False):
     assert post["compile_count"] == 1, \
         "recovery recompiled: {}".format(post["compile_count"])
 
+    # Observability gate (docs/OBSERVABILITY.md): a request the fault
+    # interrupted mid-stream must autopsy as lost-then-replayed with a
+    # contiguous hop chain — the trace proves the recovery story, not
+    # just the counters.
+    from deepspeed_tpu.telemetry import build_autopsy
+    replayed_tids = sorted({ev["tid"] for ev in engine.tracer.events()
+                            if ev["name"] == "request/replayed"})
+    assert replayed_tids, "recovery replayed but left no trace event"
+    autopsy = build_autopsy(engine.trace_recorders(), replayed_tids[0])
+    assert autopsy["replays"] >= 1, "autopsy missed the replay"
+    assert autopsy["terminal"]["cause"] == "done", \
+        "replayed request did not finish: {}".format(autopsy["terminal"])
+    assert autopsy["terminal"]["lost_then_replayed"], \
+        "autopsy did not mark the request lost-then-replayed"
+    assert autopsy["hop_gaps"] == [], \
+        "hop sequence has gaps: {}".format(autopsy["hop_gaps"])
+    _note_trace(engine)
+
     return {
         "metric": "gpt2_{}_chaos_recovery_time_s".format(
             "355m" if on_tpu else "tiny_smoke"),
@@ -1364,6 +1437,13 @@ def _measure_chaos(smoke=False):
                 chaos["slo_attainment_outside_recovery"],
             "note": "one injected fatal step fault mid-run; full windowed "
                     "report under 'chaos_report' (docs/RESILIENCE.md)",
+            "replay_autopsy": {
+                "tid": replayed_tids[0],
+                "replays": autopsy["replays"],
+                "hops": len(autopsy["hops"]),
+                "hop_gaps": autopsy["hop_gaps"],
+                "terminal": autopsy["terminal"],
+            },
             "chaos_report": report,
         },
     }
@@ -1517,6 +1597,33 @@ def _measure_fleet(smoke=False, prefix_affinity=True):
     prefix_hit_rate = fleet.prefix_hit_rate()
     compile_counts = fleet.compile_counts
     health = fleet.health
+
+    # Observability gate (docs/OBSERVABILITY.md): the autopsy of a
+    # killed-mid-stream request must show the WHOLE failover chain —
+    # old owner's failover_out, the orphan pump's re-home, the
+    # survivor's failover_in — with zero gaps in the hop sequence.
+    moved = [fr for fr in wave1 if fr.failovers > 0]
+    assert moved, "kill landed but no wave-1 request records a failover"
+    autopsy = fleet.explain(moved[0])
+    names = [h["name"] for h in autopsy["hops"]]
+    assert autopsy["failovers"] >= 1, "autopsy missed the failover"
+    assert "request/failover_out" in names and \
+        "request/failover_in" in names, \
+        "failover chain incomplete in trace: {}".format(names)
+    assert names.index("request/failover_out") < \
+        names.index("request/failover_in"), "failover hops out of order"
+    out_site = autopsy["hops"][names.index("request/failover_out")]["site"]
+    in_site = autopsy["hops"][names.index("request/failover_in")]["site"]
+    assert out_site == "replica0" and in_site != out_site, \
+        "failover arrow does not cross replicas: {} -> {}".format(
+            out_site, in_site)
+    assert autopsy["hop_gaps"] == [], \
+        "hop sequence has gaps: {}".format(autopsy["hop_gaps"])
+    assert autopsy["terminal"]["cause"] == "done" and \
+        autopsy["terminal"]["lost_then_replayed"], \
+        "killed-mid-stream request did not finish via rescue: {}".format(
+            autopsy["terminal"])
+    _note_trace(fleet)
     fleet.close()
 
     # The invariant, asserted in the artifact's own build.
@@ -1565,6 +1672,14 @@ def _measure_fleet(smoke=False, prefix_affinity=True):
             "failovers": fleet_metrics["failovers"],
             "dead_replicas": dead,
             "mid_stream_at_kill": mid_stream,
+            "failover_autopsy": {
+                "tid": autopsy["tid"],
+                "failovers": autopsy["failovers"],
+                "hops": len(autopsy["hops"]),
+                "chain": [out_site, "fleet", in_site],
+                "hop_gaps": autopsy["hop_gaps"],
+                "terminal": autopsy["terminal"],
+            },
             "survivor_compile_counts": {
                 k: v for k, v in compile_counts.items() if k != 0},
             "fleet_health_at_exit": health,
@@ -1693,6 +1808,7 @@ def _measure_disagg(smoke=False, disagg=True):
                "roles": list(fleet.roles)})
     compile_counts = fleet.compile_counts
     health = fleet.health
+    _note_trace(fleet)
     fleet.close()
 
     # Soundness of the run itself (the cross-side comparison lives in
@@ -1883,6 +1999,7 @@ def _measure_frontdoor(smoke=False, frontdoor=True):
     batch = fd_classes.get("batch", {})
     post = target.metrics() if frontdoor else engine.metrics()
     compile_count = post["compile_count"]
+    _note_trace(target)
 
     assert result.requests_lost == 0, \
         "{} accepted request(s) lost".format(result.requests_lost)

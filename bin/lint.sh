@@ -15,6 +15,11 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 echo "== graftlint =="
 python -m deepspeed_tpu.analysis deepspeed_tpu "$@"
 
+echo "== trace schema =="
+python -c "import sys; \
+from deepspeed_tpu.telemetry.distributed import _self_check; \
+sys.exit(_self_check())"
+
 echo "== compileall =="
 python -m compileall -q deepspeed_tpu
 

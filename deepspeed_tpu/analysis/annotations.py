@@ -85,4 +85,5 @@ DETERMINISM_MODULES = (
 # that also DEFINES ``_THREAD_OWNED`` opts in wherever it lives).
 THREAD_CHECKED_CLASSES = ("InferenceEngine", "ServingFleet",
                           "PrefixDirectory", "HandoffPump",
-                          "FrontDoor", "TokenStream")
+                          "FrontDoor", "TokenStream",
+                          "AlertManager", "TraceContext")

@@ -124,6 +124,7 @@ from deepspeed_tpu.telemetry import (
     prometheus_digest,
     prometheus_text,
 )
+from deepspeed_tpu.telemetry.autopsy import build_autopsy
 from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
 
@@ -645,6 +646,12 @@ class InferenceEngine(object):
             self._scheduler.occupancy)
         self.telemetry.gauge("kv_pool_bytes").set_fn(
             lambda: pool_nbytes(self._pool))
+        # Span-ring overflow as a live series: a truncated autopsy
+        # (telemetry/autopsy.py hop_gaps) is detectable from the same
+        # scrape that would have shown the alert, instead of silently
+        # incomplete. Reads 0 forever with telemetry off (NullRecorder).
+        self.telemetry.gauge("trace_spans_dropped").set_fn(
+            lambda: self.tracer.dropped)
         if self._hier is not None:
             h = self._hier
             self.telemetry.gauge("prefix_hit_rate").set_fn(h.hit_rate)
@@ -849,7 +856,7 @@ class InferenceEngine(object):
 
     def submit(self, prompt, max_new_tokens=None, temperature=0.0,
                top_k=None, eos_token_id=None, seed=0, spec_decode=None,
-               deadline_ms=None, priority=None, tenant=None):
+               deadline_ms=None, priority=None, tenant=None, trace=None):
         """Queue one request; returns its Request handle. Raises
         scheduler.QueueFull past ``max_queue`` pending requests
         (backpressure — structured with queue_depth + a retry_after_s
@@ -866,7 +873,10 @@ class InferenceEngine(object):
         admitted, it always finishes. ``priority``/``tenant``: front-door
         class and tenant tags (inference/frontdoor) — pure metadata here
         except that a QueueFull raised for a tagged submission carries
-        that class's OWN retry_after_s hint."""
+        that class's OWN retry_after_s hint. ``trace``: a propagated
+        telemetry.distributed.TraceContext — the fleet / front door pass
+        the one they minted so every hop of the request rides one Chrome
+        tid; None mints a local context (tid = rid, as ever)."""
         if not self._health.accepting:
             if self._health.state == "dead":
                 raise EngineDeadError(
@@ -915,7 +925,8 @@ class InferenceEngine(object):
                 -1 if eos_token_id is None else int(eos_token_id),
                 int(seed),
                 spec=self._spec is not None and spec_decode is not False,
-                deadline=deadline, priority=priority, tenant=tenant)
+                deadline=deadline, priority=priority, tenant=tenant,
+                trace=trace)
         except QueueFull as exc:
             raise self._augment_queue_full(exc) from None
 
@@ -1187,6 +1198,9 @@ class InferenceEngine(object):
         self._preempted_rids.add(req.rid)
         self._last_swap_out_s = time.time() - t0
         self._swap_out_hist.observe(self._last_swap_out_s)
+        self.tracer.instant("request/preempted", tid=req.trace.tid,
+                            rid=req.rid, hop=req.trace.hop(),
+                            tokens=len(req.tokens))
         return True
 
     def release_preempted(self, req=None):
@@ -1196,8 +1210,11 @@ class InferenceEngine(object):
         Idempotent; a rid that already resumed or finished is a no-op."""
         if req is None:
             self._preempt_hold.clear()
-        else:
+        elif req.rid in self._preempt_hold:
             self._preempt_hold.discard(req.rid)
+            self.tracer.instant("request/preempt_released",
+                                tid=req.trace.tid, rid=req.rid,
+                                hop=req.trace.hop())
 
     def preempted_held(self):
         """rids currently parked by preempt() and not yet released —
@@ -1336,7 +1353,8 @@ class InferenceEngine(object):
             spec=spec["spec"], deadline=spec["deadline"],
             submit_time=spec["submit_time"], admit_time=spec["admit_time"],
             first_token_time=spec["first_token_time"],
-            priority=spec.get("priority"), tenant=spec.get("tenant"))
+            priority=spec.get("priority"), tenant=spec.get("tenant"),
+            trace=spec.get("trace"), flow=spec.get("flow"))
         if pbase > 0:
             # Re-pin under the same lock the peek ran under — nothing
             # can have moved between them. The donor's pid named a row
@@ -1455,6 +1473,13 @@ class InferenceEngine(object):
                 # reads: a session that stops emitting goes stale here
                 # and becomes the preferred victim.
                 req.last_touch = harvest_t
+                # Per-chunk decode progress on the request's own track:
+                # at most one instant per emitting slot per step (ring-
+                # bounded; drops surface as trace_spans_dropped).
+                self.tracer.instant(
+                    "request/chunk", tid=req.trace.tid, rid=req.rid,
+                    hop=req.trace.hop(), emitted=len(emitted),
+                    tokens=len(req.tokens))
             if not active[slot]:
                 self._complete(req, done)
         if self._handoff_enabled:
@@ -1786,3 +1811,44 @@ class InferenceEngine(object):
         (Perfetto / chrome://tracing loadable). Raises when telemetry
         is off — an empty file would read as 'nothing happened'."""
         return self.tracer.write_chrome_trace(path)
+
+    def trace_recorders(self):
+        """This engine's span recorders as the label -> recorder map
+        the distributed merge and autopsy consume. One ring for a
+        standalone engine; the fleet overlays its own and the front
+        door's on top."""
+        label = "engine" if self.config.replica_id is None \
+            else "replica{}".format(self.config.replica_id)
+        return {label: self.tracer}
+
+    def find_request(self, rid):
+        """The Request for ``rid`` wherever it lives (queued, running,
+        swapped, mid-handoff, or completed); None when unknown."""
+        s = self._scheduler
+        req = s.completed.get(rid)
+        if req is not None:
+            return req
+        for r in s.running.values():
+            if r.rid == rid:
+                return r
+        req = s.swapped.get(rid) or s.handoff.get(rid)
+        if req is not None:
+            return req
+        for r in s.queue:
+            if r.rid == rid:
+                return r
+        return None
+
+    def explain(self, rid):
+        """Structured autopsy of one request (telemetry/autopsy.py):
+        hop-ordered timeline, admission evidence, terminal cause.
+        Raises KeyError for an unknown rid and RuntimeError with
+        telemetry off — an empty autopsy would read as 'nothing
+        happened'."""
+        if not self.config.telemetry:
+            raise RuntimeError("telemetry is disabled: no trace to "
+                               "explain")
+        req = self.find_request(rid)
+        if req is None:
+            raise KeyError("unknown rid {}".format(rid))
+        return build_autopsy(self.trace_recorders(), req.trace.tid)

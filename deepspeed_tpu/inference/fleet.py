@@ -97,6 +97,8 @@ survivor — zero lost, counted as ``handoff_fallbacks``.
 
 import dataclasses
 import itertools
+import json
+import os
 import threading
 import time
 
@@ -115,8 +117,18 @@ from deepspeed_tpu.inference.scheduler import QueueFull, RETRY_AFTER_CAP_S
 from deepspeed_tpu.parallel import mesh as mesh_lib
 from deepspeed_tpu.telemetry import (
     MergedRegistry,
+    NullRecorder,
+    SpanRecorder,
     TimeseriesCollector,
     prometheus_text,
+)
+from deepspeed_tpu.telemetry.alerts import AlertManager, default_rules
+from deepspeed_tpu.telemetry.autopsy import build_autopsy, worst_requests
+from deepspeed_tpu.telemetry.distributed import (
+    FLEET_TID_BASE,
+    TraceContext,
+    merged_trace,
+    write_merged_trace,
 )
 from deepspeed_tpu.utils.logging import logger
 
@@ -130,14 +142,18 @@ class FleetRequest(object):
     prior owners plus the current owner's record, in emission order —
     one continuous bit-identical stream."""
 
-    __slots__ = ("fid", "replica_id", "failovers", "_req", "_prior",
-                 "_submit_time", "_first_token_time", "_finish_time",
-                 "_cancelled", "_respec")
+    __slots__ = ("fid", "replica_id", "failovers", "trace", "_req",
+                 "_prior", "_submit_time", "_first_token_time",
+                 "_finish_time", "_cancelled", "_respec")
 
     def __init__(self, fid, replica_id, req):
         self.fid = fid
         self.replica_id = replica_id   # current owner; None mid-failover
         self.failovers = 0
+        # The propagated trace identity — shared BY REFERENCE with the
+        # engine Request, so it survives _req being detached and
+        # re-pointed across failovers/handoffs.
+        self.trace = req.trace
         self._req = req                # current engine Request record
         self._prior = []               # tokens emitted on dead replicas
         self._submit_time = req.submit_time
@@ -209,6 +225,7 @@ class FleetRequest(object):
         if emitted:
             prompt = np.concatenate(
                 [prompt, np.asarray(emitted, np.int32)])
+        self.failovers += 1
         self._respec = {
             "prompt": prompt,
             "max_new_tokens": req.max_new_tokens - len(emitted),
@@ -220,10 +237,17 @@ class FleetRequest(object):
             "deadline": req.deadline,
             "priority": req.priority,
             "tenant": req.tenant,
+            # Trace carries BY REFERENCE so the survivor's events stay
+            # on the same tid with the same hop counter; ``flow`` is
+            # the failover arrow's key — the dead owner's failover_out
+            # and the survivor's failover_in both stamp it, and the
+            # merge pairs them into one s/f pair.
+            "trace": req.trace,
+            "flow": "failover/{}/{}".format(req.trace.tid,
+                                            self.failovers),
         }
         self._req = None
         self.replica_id = None
-        self.failovers += 1
 
     def _mark_cancelled(self, now):
         self._cancelled = True
@@ -387,7 +411,8 @@ class ServingFleet(object):
                  window_seconds=1.0, window_capacity=512, start=True,
                  breaker_factory=None, idle_wait_s=0.01, poll_s=0.002,
                  prefix_affinity=None, roles=None,
-                 latency_classes=("interactive",)):
+                 latency_classes=("interactive",), alert_rules=None,
+                 dump_dir=None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1, got "
                              "{}".format(n_replicas))
@@ -466,9 +491,32 @@ class ServingFleet(object):
             capacity=window_capacity)
         self.collector.start()
         self.counters = _FleetCounters(self.replicas)
+        # Fleet-window base: metrics(reset=True) snapshots the cumulative
+        # sums here so the aggregate windows like a lone engine's metrics
+        # without touching the counter windows the collector owns.
+        self._agg_base = {}
+        # Fleet-plane flight ring: routing decisions, failover arrows,
+        # prefix-ship flows — everything that happens BETWEEN replicas
+        # and so belongs to no engine's ring. Merged with the replica
+        # rings by write_trace()/explain().
+        self.tracer = (SpanRecorder(capacity=2048)
+                       if config.telemetry else NullRecorder())
+        # SLO burn-rate alerting over the collector's windows
+        # (telemetry/alerts.py), evaluated from _tick() whenever a
+        # window closes. ``dump_dir`` arms the auto-dump: a firing rule
+        # or a replica death writes the merged trace + worst-K
+        # autopsies there before anyone has to ask.
+        self._dump_dir = dump_dir
+        self.dumps = []
+        self.alerts = AlertManager(
+            self.collector,
+            default_rules() if alert_rules is None else alert_rules,
+            on_fire=[lambda rule, rec:
+                     self._auto_dump("alert:" + rule.name)])
         self._lock = threading.RLock()
         self._tick_lock = threading.Lock()
         self._fids = itertools.count()
+        self._flow_ids = itertools.count(1)  # prefix-ship flow keys
         self._requests = {}     # fid -> FleetRequest (until harvested)
         self._orphans = []      # FleetRequests awaiting resubmission
         self._handoffs = HandoffPump()
@@ -646,6 +694,7 @@ class ServingFleet(object):
             "first_token_time": req.first_token_time,
             "priority": req.priority,
             "tenant": req.tenant,
+            "trace": req.trace,
         }
 
     def _place_handoff(self, fr, donor, req, record, t0):
@@ -661,6 +710,16 @@ class ServingFleet(object):
                 self._settle_handoff(donor, req, t0, "dropped")
                 return True
             spec = self._build_handoff_spec(req)
+        # Donor-side anchor for the migration arrow: the acceptor's
+        # handoff_in (scheduler.adopt) closes the same flow key. The
+        # key reuses the anchor's own hop number so every consumed hop
+        # is stamped on exactly one event (hop_gaps stays empty).
+        hop = req.trace.hop()
+        spec["flow"] = "handoff/{}/{}".format(req.trace.tid, hop)
+        donor.engine.tracer.instant(
+            "request/handoff_out", tid=req.trace.tid, rid=req.rid,
+            hop=hop, flow_out=spec["flow"], fid=fr.fid,
+            tokens_emitted=len(spec["prompt"]) - len(req.prompt))
         pbase = int(np.asarray(record["pbase"])) if "pbase" in record else 0
         acceptors = self._ordered(include_draining=True, role="decode")
         if not acceptors:
@@ -748,8 +807,16 @@ class ServingFleet(object):
             if acc.failed:
                 return False
             ok = acc.engine.adopt_prefix(matched, prec)
-        if ok and self._directory is not None:
-            self._directory.add(acc.rid, matched)
+        if ok:
+            key = "prefix/{}".format(next(self._flow_ids))
+            donor.engine.tracer.instant(
+                "prefix/ship_out", flow_out=key, tokens=len(matched),
+                to_replica=acc.rid)
+            acc.engine.tracer.instant(
+                "prefix/ship_in", flow_in=key, tokens=len(matched),
+                from_replica=donor.rid)
+            if self._directory is not None:
+                self._directory.add(acc.rid, matched)
         return ok
 
     def _settle_handoff(self, donor, req, t0, outcome):
@@ -784,6 +851,14 @@ class ServingFleet(object):
             if live:
                 fr._orphan()
                 self._orphans.append(fr)
+        if live:
+            # The migration degraded into a re-prefill: open the arrow
+            # the survivor's failover_in closes (same key _orphan
+            # minted into the respec).
+            donor.engine.tracer.instant(
+                "request/handoff_fallback", tid=fr.trace.tid,
+                fid=fr.fid, hop=fr.trace.hop(),
+                flow_out=fr._respec["flow"])
         self._settle_handoff(donor, req, t0,
                              "fallback" if live else "dropped")
         self._pump()
@@ -792,11 +867,17 @@ class ServingFleet(object):
     def _tick(self):
         # Non-blocking: whichever thread hits the window boundary first
         # closes it; everyone else skips rather than queueing up.
+        closed = None
         if self._tick_lock.acquire(False):
             try:
-                self.collector.tick()
+                closed = self.collector.tick()
             finally:
                 self._tick_lock.release()
+        if closed is not None:
+            # A window just closed — score the alert rules against it.
+            # Outside the tick lock: evaluate() serializes on its own
+            # lock and fires dump hooks, which must not block ticking.
+            self.alerts.evaluate()
 
     # ------------------------------------------------------------- submit
 
@@ -902,6 +983,13 @@ class ServingFleet(object):
                 return False
             ok = rep.engine.adopt_prefix(matched, record)
         if ok:
+            key = "prefix/{}".format(next(self._flow_ids))
+            donor.engine.tracer.instant(
+                "prefix/ship_out", flow_out=key, tokens=len(matched),
+                to_replica=rep.rid)
+            rep.engine.tracer.instant(
+                "prefix/ship_in", flow_in=key, tokens=len(matched),
+                from_replica=donor.rid)
             self._directory.add(rep.rid, matched)
         return ok or own >= minp
 
@@ -924,6 +1012,15 @@ class ServingFleet(object):
             raise RuntimeError("submit() on a closed fleet")
         if self._orphans:
             self._pump()
+        # fid and trace context are allocated BEFORE placement so the
+        # routing decision itself lands on the request's track. The
+        # front door passes the context it minted (kw["trace"]); a bare
+        # fleet submission gets a fleet-origin one (tid = base + fid).
+        fid = next(self._fids)
+        ctx = kw.pop("trace", None)
+        if ctx is None:
+            ctx = TraceContext(FLEET_TID_BASE + fid, origin="fleet")
+        kw["trace"] = ctx
         match = self._match_prefix(prompt)
         role = "prefill" if self._disagg else None
         shallow = kw.get("priority") in self._latency_classes
@@ -963,8 +1060,19 @@ class ServingFleet(object):
                 if affine:
                     rep.engine.counters["affinity_routed"] += 1
                 with self._lock:
-                    fr = FleetRequest(next(self._fids), rep.rid, req)
+                    fr = FleetRequest(fid, rep.rid, req)
                     self._requests[fr.fid] = fr
+            # Routing evidence on the fleet plane: which replica won,
+            # what the router saw. The per-replica score inputs are the
+            # live gauges — copy the winner's so the autopsy shows the
+            # decision-time facts, not a later scrape.
+            self.tracer.instant(
+                "request/routed", tid=ctx.tid, hop=ctx.hop(),
+                fid=fid, replica=rep.rid,
+                queue_depth=int(rep.queue_depth),
+                slot_occupancy=round(float(rep.slot_occupancy), 4),
+                affinity=bool(affine), shallow=bool(shallow),
+                role=role or "any")
             rep.wake.set()
             return fr
         # MIN across per-replica hints (each already class-aware — the
@@ -1099,6 +1207,16 @@ class ServingFleet(object):
                          if fr.replica_id == rep.rid and not fr.done]
                 for fr in moved:
                     fr._orphan()
+                    # The dead owner's last word on this stream: a
+                    # host-side instant on ITS ring (the ring outlives
+                    # the pool) opening the failover arrow the
+                    # survivor's failover_in closes.
+                    rep.engine.tracer.instant(
+                        "request/failover_out", tid=fr.trace.tid,
+                        fid=fr.fid, hop=fr.trace.hop(),
+                        flow_out=fr._respec["flow"],
+                        tokens_emitted=len(fr._prior),
+                        error=type(exc).__name__)
                 self._orphans.extend(moved)
                 self.failovers += len(moved)
                 if self._directory is not None:
@@ -1110,6 +1228,7 @@ class ServingFleet(object):
             "fleet: replica %d is dead (%s: %s) — failing over %d live "
             "request(s) to survivors", rep.rid, type(exc).__name__, exc,
             len(moved))
+        self._auto_dump("replica_death:{}".format(rep.rid))
         self._pump()
 
     def _pump(self):
@@ -1152,9 +1271,18 @@ class ServingFleet(object):
                         spec["eos_token_id"], spec["seed"],
                         spec=spec["spec"], deadline=spec["deadline"],
                         priority=spec.get("priority"),
-                        tenant=spec.get("tenant"))
+                        tenant=spec.get("tenant"),
+                        trace=spec.get("trace"))
                 except QueueFull:
                     continue
+                # Close the failover arrow on the survivor's ring —
+                # the flow key pairs with the dead owner's
+                # failover_out (or the fallback's handoff_fallback).
+                rep.engine.tracer.instant(
+                    "request/failover_in", tid=req.trace.tid,
+                    fid=fr.fid, hop=req.trace.hop(),
+                    flow_in=spec.get("flow"), replica=rep.rid,
+                    budget_left=int(spec["max_new_tokens"]))
                 with self._lock:
                     fr._req = req
                     fr.replica_id = rep.rid
@@ -1353,7 +1481,17 @@ class ServingFleet(object):
         """Aggregated fleet view + per-replica engine metrics. NOTE:
         ``reset=True`` forwards to every engine and so touches the same
         windows the fleet's TimeseriesCollector owns — same single-
-        window-owner caveat as a lone engine (telemetry/timeseries.py)."""
+        window-owner caveat as a lone engine (telemetry/timeseries.py).
+
+        The aggregate counters window against the FLEET's own base (a
+        cumulative read minus the snapshot taken at the last
+        ``metrics(reset=True)``), never against the per-engine counter
+        windows — those belong to the collector and are clobbered on
+        every tick. Two successive metrics(reset=True) calls therefore
+        bracket exactly the work between them (how bench scrubs
+        warmup), fleet and single-engine runs alike; with no reset the
+        values are since-construction, including dead replicas'
+        history."""
         per_replica = {rep.rid: rep.engine.metrics(reset=reset)
                        for rep in self.replicas}
         agg = {}
@@ -1365,7 +1503,10 @@ class ServingFleet(object):
                      "handoff_fallbacks", "handoff_bytes_shipped",
                      "preemptions", "preempt_resumes"):
             if name in self.counters:
-                agg[name] = self.counters[name]
+                total = self.counters[name]
+                agg[name] = total - self._agg_base.get(name, 0)
+                if reset:
+                    self._agg_base[name] = total
         agg.update({
             "n_replicas": len(self.replicas),
             "alive": sum(1 for rep in self.replicas if rep.alive),
@@ -1380,6 +1521,8 @@ class ServingFleet(object):
         if self._directory is not None:
             agg["prefix_directory"] = self._directory.snapshot()
             agg["prefix_hit_rate"] = self.prefix_hit_rate()
+        agg["alerts_firing"] = sorted(self.alerts.firing())
+        agg["alerts_fired"] = len(self.alerts.fired())
         return {"fleet": agg, "replicas": per_replica}
 
     def prefix_hit_rate(self):
@@ -1394,8 +1537,94 @@ class ServingFleet(object):
     def prometheus(self):
         """One text-exposition snapshot of the WHOLE fleet: the merged
         registry exports every replica's series side by side, each
-        carrying its ``replica`` label."""
-        return prometheus_text(self.telemetry)
+        carrying its ``replica`` label, plus the alert manager's own
+        registry (``alerts_firing``, ``alerts_fired_total``, per-rule
+        ``alert_active``) — one scrape covers serving AND paging."""
+        return (prometheus_text(self.telemetry)
+                + prometheus_text(self.alerts.telemetry))
+
+    # ------------------------------------------------------------- tracing
+
+    def trace_recorders(self):
+        """Every ring a fleet request may have stamped, labelled:
+        ``fleet`` (routing / failover plane) plus each replica's
+        engine ring. The recorder set explain()/write_trace()/the
+        auto-dump all read."""
+        recs = {"fleet": self.tracer}
+        for rep in self.replicas:
+            recs.update(rep.engine.trace_recorders())
+        return recs
+
+    def write_trace(self, path):
+        """Merge every ring into ONE Perfetto-loadable trace: each ring
+        becomes its own process row (re-anchored to a shared epoch),
+        flow arrows bind the cross-replica hops (handoff donor ->
+        acceptor, failover dead owner -> survivor, prefix ship), and
+        the collector's windowed counters ride along as counter
+        tracks."""
+        if isinstance(self.tracer, NullRecorder):
+            raise RuntimeError("telemetry is disabled: no trace to write")
+        return write_merged_trace(
+            path, self.trace_recorders(),
+            extra_events=self.collector.chrome_counter_events())
+
+    def _resolve_tid(self, fr_or_fid):
+        with self._lock:
+            if isinstance(fr_or_fid, FleetRequest):
+                return fr_or_fid.trace.tid
+            fr = self._requests.get(fr_or_fid)
+        if fr is None:
+            raise KeyError("unknown fleet request: {!r}".format(fr_or_fid))
+        return fr.trace.tid
+
+    def explain(self, fr_or_fid):
+        """Structured autopsy of one request (telemetry/autopsy.py):
+        the hop-ordered timeline across every ring it touched, the
+        admission/routing evidence at decision time, and the terminal
+        cause. Accepts the FleetRequest handle or its fid (handles of
+        harvested requests keep working — the rings remember them)."""
+        if isinstance(self.tracer, NullRecorder):
+            raise RuntimeError(
+                "telemetry is disabled: no trace to explain")
+        return build_autopsy(self.trace_recorders(),
+                             self._resolve_tid(fr_or_fid))
+
+    def _auto_dump(self, cause):
+        """Evidence-on-disk hook for a firing alert or a replica death:
+        write the merged trace plus the worst-K request autopsies into
+        ``dump_dir`` and record the dump in ``self.dumps``. No-op
+        without a dump_dir or with telemetry off; never raises (the
+        serving loop must not die of its own black box)."""
+        if self._dump_dir is None or isinstance(self.tracer, NullRecorder):
+            return None
+        try:
+            n = len(self.dumps)
+            stem = "dump{:03d}_{}".format(
+                n, "".join(ch if ch.isalnum() else "_"
+                           for ch in str(cause)))
+            trace_path = os.path.join(self._dump_dir, stem + ".trace.json")
+            self.write_trace(trace_path)
+            with self._lock:
+                frs = list(self._requests.values())
+            recs = self.trace_recorders()
+            autopsies = [build_autopsy(recs, fr.trace.tid) for fr in frs]
+            worst = worst_requests(autopsies, k=4)
+            autopsy_path = os.path.join(
+                self._dump_dir, stem + ".autopsies.json")
+            with open(autopsy_path, "w") as f:
+                json.dump({"cause": str(cause),
+                           "firing": self.alerts.firing(),
+                           "worst_requests": worst}, f, indent=1)
+            record = {"cause": str(cause), "trace": trace_path,
+                      "autopsies": autopsy_path, "requests": len(worst)}
+            self.dumps.append(record)
+            logger.warning("fleet: auto-dump (%s) -> %s", cause,
+                           trace_path)
+            return record
+        except Exception:  # noqa: BLE001 — the black box must never
+            # take down the serving loop that feeds it.
+            logger.exception("fleet: auto-dump failed (%s)", cause)
+            return None
 
     @property
     def compile_counts(self):

@@ -50,6 +50,13 @@ from deepspeed_tpu.inference.frontdoor.stream import TokenStream
 from deepspeed_tpu.inference.resilience import EngineDeadError, EngineDraining
 from deepspeed_tpu.inference.scheduler import QueueFull, RETRY_AFTER_CAP_S
 from deepspeed_tpu.telemetry import MetricsRegistry, prometheus_text
+from deepspeed_tpu.telemetry.autopsy import build_autopsy
+from deepspeed_tpu.telemetry.distributed import (
+    FRONTDOOR_TID_BASE,
+    TraceContext,
+    write_merged_trace,
+)
+from deepspeed_tpu.telemetry.tracing import NullRecorder, SpanRecorder
 
 
 class FrontDoorHandle(object):
@@ -64,11 +71,14 @@ class FrontDoorHandle(object):
 
     __slots__ = ("hid", "prompt", "max_new_tokens", "kw", "priority",
                  "tenant", "deadline", "submit_time", "dispatch_time",
-                 "preempt_count", "_req", "_local_phase", "_finish_time")
+                 "preempt_count", "trace", "_req", "_local_phase",
+                 "_finish_time")
 
     def __init__(self, hid, prompt, max_new_tokens, kw, priority, tenant,
-                 deadline, now):
+                 deadline, now, trace=None):
         self.hid = hid
+        self.trace = trace if trace is not None else TraceContext(
+            FRONTDOOR_TID_BASE + hid, origin="frontdoor")
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.kw = kw                  # sampling params forwarded verbatim
@@ -166,6 +176,13 @@ class FrontDoor(object):
         # ``telemetry`` below returns the TARGET registry so the
         # runner's TimeseriesCollector keeps seeing engine histograms).
         self.registry = MetricsRegistry(engine="frontdoor")
+        # The front door's OWN ring: admission verdicts (with the
+        # predictor's evidence at decision time), dispatches, lane
+        # expiries — the first hops of every request's distributed
+        # trace. Follows the target's telemetry switch.
+        self.tracer = (SpanRecorder(capacity=2048)
+                       if getattr(target.config, "telemetry", False)
+                       else NullRecorder())
 
     # ------------------------------------------------------ target probes
 
@@ -242,7 +259,19 @@ class FrontDoor(object):
         self._admission.observe_poll(counters["requests_completed"],
                                      counters["tokens_out"])
 
-    def _shed(self, reason, cls, tname, message, retry=None):
+    def _predictor_evidence(self):
+        """The admission predictor's state RIGHT NOW — copied onto the
+        admitted/shed trace event so an autopsy shows the inputs the
+        verdict was computed from, not a later reconstruction."""
+        a = self._admission
+        return {
+            "predictor_cold": a.cold,
+            "completion_rate": a._rate,
+            "token_rate": a._token_rate,
+            "service_base_s": a._service_base,
+        }
+
+    def _shed(self, reason, cls, tname, message, retry=None, ctx=None):
         """Structured rejection: count it, label it, and raise a
         QueueFull whose retry_after_s is the CLASS's own hint (never
         another class's backpressure) clamped like the scheduler's."""
@@ -255,6 +284,12 @@ class FrontDoor(object):
             else self._admission.retry_hint_s(cls.name)
         if hint is not None:
             hint = round(min(max(float(hint), 0.0), RETRY_AFTER_CAP_S), 4)
+        if ctx is not None:
+            self.tracer.instant(
+                "request/shed", tid=ctx.tid, hop=ctx.hop(),
+                reason=reason, priority=cls.name, tenant=tname,
+                retry_after_s=hint, queue_depth=self._pending_total(),
+                **self._predictor_evidence())
         raise QueueFull(message,
                         queue_depth=self._pending_total(),
                         retry_after_s=hint, priority=cls.name,
@@ -274,6 +309,12 @@ class FrontDoor(object):
             cls = self._resolve_class(priority)
             tname, policy = self._resolve_tenant(tenant)
             now = self._clock()
+            # The trace context exists BEFORE the first verdict: a shed
+            # is as much a lifecycle event as an admission, and the
+            # autopsy of a shed request starts here.
+            hid = next(self._hids)
+            ctx = TraceContext(FRONTDOOR_TID_BASE + hid,
+                               origin="frontdoor")
             self._observe()
             if policy is not None and policy.rate is not None:
                 bucket = self._buckets.get(tname)
@@ -286,18 +327,20 @@ class FrontDoor(object):
                         "rate_limit", cls, tname,
                         "tenant {!r} over its {:.3g} req/s rate "
                         "limit".format(tname, policy.rate),
-                        retry=bucket.retry_after(now))
+                        retry=bucket.retry_after(now), ctx=ctx)
             lane = self._lanes.setdefault((cls.name, tname),
                                           collections.deque())
             if len(lane) >= cls.max_pending:
                 self._shed(
                     "frontdoor_full", cls, tname,
                     "front-door lane {}/{} at max_pending={}".format(
-                        cls.name, tname, cls.max_pending))
+                        cls.name, tname, cls.max_pending), ctx=ctx)
             mnt = max_new_tokens
             if mnt is None:
                 mnt = self._default_max_new()
             deadline = None
+            eta = None
+            pred = None
             if deadline_ms is not None:
                 if deadline_ms <= 0:
                     raise ValueError("deadline_ms must be > 0, got "
@@ -311,7 +354,7 @@ class FrontDoor(object):
                         "predicted completion {:.3f}s exceeds deadline "
                         "{:.3f}s — shedding at submit beats burning a "
                         "slot on a missed deadline".format(
-                            eta, deadline_ms / 1e3))
+                            eta, deadline_ms / 1e3), ctx=ctx)
             if cls.is_latency:
                 pred = self._admission.predict_ttft_s(
                     self._work_ahead(cls))
@@ -329,9 +372,9 @@ class FrontDoor(object):
                             "predicted TTFT {:.3f}s exceeds the {} "
                             "budget {:.3f}s even after "
                             "preemption".format(pred, cls.name,
-                                                cls.budget_s))
-            h = FrontDoorHandle(next(self._hids), prompt, mnt, dict(kw),
-                                cls.name, tname, deadline, now)
+                                                cls.budget_s), ctx=ctx)
+            h = FrontDoorHandle(hid, prompt, mnt, dict(kw),
+                                cls.name, tname, deadline, now, trace=ctx)
             lane.append(h)
             self._stats["admitted"] += 1
             akey = (cls.name, tname)
@@ -339,6 +382,13 @@ class FrontDoor(object):
                                                                 0) + 1
             self.registry.counter("frontdoor_admissions",
                                   priority=cls.name, tenant=tname).inc()
+            self.tracer.instant(
+                "request/admitted", tid=ctx.tid, hop=ctx.hop(),
+                hid=hid, priority=cls.name, tenant=tname,
+                work_ahead=self._work_ahead(cls),
+                predicted_ttft_s=pred, predicted_e2e_s=eta,
+                deadline_ms=deadline_ms,
+                **self._predictor_evidence())
             self._dispatch()
             return h
 
@@ -363,7 +413,8 @@ class FrontDoor(object):
         """Wrap an existing handle in a TokenStream (one consumer)."""
         return TokenStream(handle, pump=self._pump_stream,
                            poll_s=self.config.stream_poll_s,
-                           cancel=lambda: self.cancel(handle))
+                           cancel=lambda: self.cancel(handle),
+                           tracer=self.tracer)
 
     def _pump_stream(self):
         """Make progress for a blocked stream consumer. Returns whether
@@ -493,9 +544,14 @@ class FrontDoor(object):
         req = self.target.submit(h.prompt,
                                  max_new_tokens=h.max_new_tokens,
                                  priority=h.priority, tenant=h.tenant,
-                                 **kw)
+                                 trace=h.trace, **kw)
         h._req = req
         h.dispatch_time = self._clock()
+        self.tracer.instant(
+            "request/dispatched", tid=h.trace.tid, hop=h.trace.hop(),
+            hid=h.hid, rid=req.rid,
+            lane_wait_ms=round((h.dispatch_time - h.submit_time) * 1e3,
+                               3))
 
     def _expire_pending(self):
         """Deadline lapse while still in a front-door lane: settle the
@@ -514,6 +570,9 @@ class FrontDoor(object):
                 self._stats["expired"] += 1
                 self.registry.counter("frontdoor_expired",
                                       priority=cname, tenant=tname).inc()
+                self.tracer.instant(
+                    "request/expired", tid=h.trace.tid,
+                    hop=h.trace.hop(), hid=h.hid, where="frontdoor_lane")
 
     # -------------------------------------------------------- preemption
 
@@ -654,6 +713,10 @@ class FrontDoor(object):
                     lane.remove(handle)
                 handle._settle("cancelled", self._clock())
                 self._finished.append(handle)
+                self.tracer.instant(
+                    "request/cancelled", tid=handle.trace.tid,
+                    hop=handle.trace.hop(), hid=handle.hid,
+                    where="frontdoor_lane")
                 return True
             if handle in self._preempted:
                 self._preempted.remove(handle)
@@ -726,3 +789,41 @@ class FrontDoor(object):
         families (labelled priority/tenant/reason)."""
         with self._lock:
             return self.target.prometheus() + prometheus_text(self.registry)
+
+    # ------------------------------------------------------------- tracing
+
+    def trace_recorders(self):
+        """Every ring a front-door request may have stamped: ours
+        (admission / dispatch / lane verdicts) plus the target's —
+        the fleet merges its own plane and each replica's ring; a bare
+        engine contributes one."""
+        recs = {"frontdoor": self.tracer}
+        recs.update(self.target.trace_recorders())
+        return recs
+
+    def write_trace(self, path):
+        """One merged Perfetto-loadable trace across the front door
+        and everything behind it (telemetry/distributed.py)."""
+        if isinstance(self.tracer, NullRecorder):
+            raise RuntimeError("telemetry is disabled: no trace to write")
+        extra = None
+        collector = getattr(self.target, "collector", None)
+        if collector is not None:
+            extra = collector.chrome_counter_events()
+        return write_merged_trace(path, self.trace_recorders(),
+                                  extra_events=extra)
+
+    def explain(self, handle_or_hid):
+        """Structured autopsy of one front-door request — the full
+        chain from admission verdict (with the predictor's evidence)
+        through routing, dispatch, per-chunk decode, preemption,
+        handoff and failover to the terminal cause. Accepts the
+        FrontDoorHandle or its hid."""
+        if isinstance(self.tracer, NullRecorder):
+            raise RuntimeError(
+                "telemetry is disabled: no trace to explain")
+        if isinstance(handle_or_hid, FrontDoorHandle):
+            tid = handle_or_hid.trace.tid
+        else:
+            tid = FRONTDOOR_TID_BASE + int(handle_or_hid)
+        return build_autopsy(self.trace_recorders(), tid)

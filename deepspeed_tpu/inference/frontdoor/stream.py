@@ -30,19 +30,33 @@ class TokenStream(object):
     # Consumed by exactly one thread; the handle's token list is only
     # ever read (never mutated) here, and the cursor/closed scalars
     # belong to the consumer.
-    _THREAD_OWNED = frozenset({"_cursor", "_closed"})
+    _THREAD_OWNED = frozenset({"_cursor", "_closed", "_first_seen"})
 
     # Phases with no further tokens coming — the scheduler Request's
     # terminal phases plus the front door's pre-dispatch verdicts.
     _TERMINAL = ("done", "cancelled", "expired", "failed")
 
-    def __init__(self, handle, pump, poll_s=0.002, cancel=None):
+    def __init__(self, handle, pump, poll_s=0.002, cancel=None,
+                 tracer=None):
         self._handle = handle
         self._pump = pump
         self._cancel = cancel
         self._poll_s = float(poll_s)
         self._cursor = 0
         self._closed = False
+        self._first_seen = False
+        self._tracer = tracer
+        self._trace = getattr(handle, "trace", None)
+
+    def _mark(self, name, **args):
+        """Consumer-side lifecycle instant on the front door's ring —
+        stream events carry the same trace context as the rest of the
+        request's hops, so the autopsy sees delivery, not just
+        generation."""
+        if self._tracer is None or self._trace is None:
+            return
+        self._tracer.instant(name, tid=self._trace.tid,
+                             hop=self._trace.hop(), **args)
 
     # ------------------------------------------------------- iterator
 
@@ -57,6 +71,9 @@ class TokenStream(object):
             if self._cursor < len(toks):
                 tok = toks[self._cursor]
                 self._cursor += 1
+                if not self._first_seen:
+                    self._first_seen = True
+                    self._mark("stream/first_token")
                 return tok
             # No unread token. Re-check tokens AFTER observing a
             # terminal phase — the finishing step appends the last
@@ -67,6 +84,8 @@ class TokenStream(object):
                 if self._cursor < len(toks):
                     continue
                 self._closed = True
+                self._mark("stream/drained", tokens=self._cursor,
+                           phase=self._handle.phase)
                 raise StopIteration
             made_progress = self._pump()
             if not made_progress:
@@ -87,6 +106,7 @@ class TokenStream(object):
         if self._closed:
             return
         self._closed = True
+        self._mark("stream/closed", tokens=self._cursor)
         if self._cancel is not None and \
                 self._handle.phase not in self._TERMINAL:
             self._cancel()

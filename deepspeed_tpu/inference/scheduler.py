@@ -47,6 +47,8 @@ import collections
 import itertools
 import time
 
+from deepspeed_tpu.telemetry.distributed import TraceContext
+
 # retry_after_s ceiling: on a cold completions window (two completions
 # minutes apart) the naive 1/rate estimate is astronomical, and router
 # backoff math multiplying it would park a replica forever. One minute
@@ -99,12 +101,19 @@ class Request(object):
                  "eos_token_id", "seed", "spec", "tokens", "slot", "phase",
                  "cursor", "submit_time", "admit_time", "first_token_time",
                  "finish_time", "deadline", "replays", "last_touch",
-                 "priority", "tenant")
+                 "priority", "tenant", "trace")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature, top_k,
                  eos_token_id, seed, spec=False, deadline=None,
-                 priority=None, tenant=None):
+                 priority=None, tenant=None, trace=None):
         self.rid = rid
+        # Propagated trace identity (telemetry/distributed.py): the
+        # Chrome tid every lifecycle event rides plus the shared hop
+        # counter. Created upstream (FrontDoor / fleet) and carried by
+        # reference across handoffs and failovers; a bare engine mints
+        # a local one so tid == rid exactly as before.
+        self.trace = trace if trace is not None \
+            else TraceContext(rid, origin="local")
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
@@ -250,15 +259,19 @@ class Scheduler(object):
 
     def submit(self, prompt, max_new_tokens, temperature, top_k,
                eos_token_id, seed, spec=False, deadline=None,
-               priority=None, tenant=None):
+               priority=None, tenant=None, trace=None):
         if len(self.queue) >= self.max_queue:
             raise self.queue_full_error(priority=priority, tenant=tenant)
         req = Request(next(self._ids), prompt, max_new_tokens, temperature,
                       top_k, eos_token_id, seed, spec, deadline=deadline,
-                      priority=priority, tenant=tenant)
+                      priority=priority, tenant=tenant, trace=trace)
         if deadline is not None:
             self._has_deadlines = True
         self.queue.append(req)
+        if self.tracer is not None:
+            self.tracer.instant("request/submitted", tid=req.trace.tid,
+                                rid=req.rid, hop=req.trace.hop(),
+                                queue_depth=len(self.queue))
         return req
 
     # --------------------------------------------------------- admission
@@ -286,11 +299,12 @@ class Scheduler(object):
             if self._deadline_sheds is not None:
                 self._deadline_sheds.inc()
             if self.tracer is not None:
-                self.tracer.instant("request/expired", tid=req.rid,
-                                    rid=req.rid,
+                self.tracer.instant("request/expired", tid=req.trace.tid,
+                                    rid=req.rid, hop=req.trace.hop(),
                                     waited_s=round(now - req.submit_time, 4))
                 self.tracer.span("request", req.submit_time, req.finish_time,
-                                 tid=req.rid, rid=req.rid, tokens=0,
+                                 tid=req.trace.tid, rid=req.rid,
+                                 hop=req.trace.hop(), tokens=0,
                                  phase="expired")
         return expired
 
@@ -326,8 +340,8 @@ class Scheduler(object):
                 self._queue_wait.observe(req.admit_time - req.submit_time)
             if self.tracer is not None:
                 self.tracer.span("request/queued", req.submit_time,
-                                 req.admit_time, tid=req.rid,
-                                 rid=req.rid, slot=slot,
+                                 req.admit_time, tid=req.trace.tid,
+                                 rid=req.rid, hop=req.trace.hop(), slot=slot,
                                  prompt_tokens=int(req.prompt.size))
         return pairs
 
@@ -350,7 +364,8 @@ class Scheduler(object):
             req.phase = "decoding"
             if self.tracer is not None:
                 self.tracer.span("request/prefill", req.admit_time,
-                                 tid=req.rid, rid=req.rid, slot=req.slot,
+                                 tid=req.trace.tid, rid=req.rid,
+                                 hop=req.trace.hop(), slot=req.slot,
                                  prompt_tokens=int(req.prompt.size))
             return True
         return False
@@ -368,8 +383,9 @@ class Scheduler(object):
         req.phase = "swapped"
         self.swapped[req.rid] = req
         if self.tracer is not None:
-            self.tracer.instant("request/swapped_out", tid=req.rid,
-                                rid=req.rid, tokens=len(req.tokens))
+            self.tracer.instant("request/swapped_out", tid=req.trace.tid,
+                                rid=req.rid, hop=req.trace.hop(),
+                                tokens=len(req.tokens))
 
     def next_swap_in(self, skip=()):
         """The longest-swapped session, or None — resume-first fairness:
@@ -394,8 +410,8 @@ class Scheduler(object):
         req.phase = "decoding"
         self.running[slot] = req
         if self.tracer is not None:
-            self.tracer.instant("request/swapped_in", tid=req.rid,
-                                rid=req.rid, slot=slot,
+            self.tracer.instant("request/swapped_in", tid=req.trace.tid,
+                                rid=req.rid, hop=req.trace.hop(), slot=slot,
                                 tokens=len(req.tokens))
 
     # ----------------------------------------------- disaggregated handoff
@@ -415,8 +431,9 @@ class Scheduler(object):
         req.phase = "handoff"
         self.handoff[req.rid] = req
         if self.tracer is not None:
-            self.tracer.instant("request/handoff", tid=req.rid,
-                                rid=req.rid, tokens=len(req.tokens))
+            self.tracer.instant("request/handoff", tid=req.trace.tid,
+                                rid=req.rid, hop=req.trace.hop(),
+                                tokens=len(req.tokens))
 
     def finish_handoff(self, req):
         """The migration settled — adopted by a peer replica, or fallen
@@ -429,7 +446,7 @@ class Scheduler(object):
     def adopt(self, prompt, max_new_tokens, temperature, top_k,
               eos_token_id, seed, slot, spec=False, deadline=None,
               submit_time=None, admit_time=None, first_token_time=None,
-              priority=None, tenant=None):
+              priority=None, tenant=None, trace=None, flow=None):
         """ACCEPTOR-side constructor: install a request migrated from a
         prefill-role peer straight into ``slot`` in the ``decoding``
         phase — it never queues here and never rides the prefill lane
@@ -443,7 +460,7 @@ class Scheduler(object):
         assert slot not in self.running, slot
         req = Request(next(self._ids), prompt, max_new_tokens, temperature,
                       top_k, eos_token_id, seed, spec, deadline=deadline,
-                      priority=priority, tenant=tenant)
+                      priority=priority, tenant=tenant, trace=trace)
         if submit_time is not None:
             req.submit_time = submit_time
             req.last_touch = submit_time
@@ -455,9 +472,13 @@ class Scheduler(object):
         req.phase = "decoding"
         self.running[slot] = req
         if self.tracer is not None:
-            self.tracer.instant("request/handoff_in", tid=req.rid,
-                                rid=req.rid, slot=slot,
-                                prompt_tokens=int(prompt.size))
+            args = {"rid": req.rid, "slot": slot,
+                    "prompt_tokens": int(prompt.size),
+                    "hop": req.trace.hop()}
+            if flow is not None:
+                args["flow_in"] = flow
+            self.tracer.instant("request/handoff_in", tid=req.trace.tid,
+                                **args)
         return req
 
     # -------------------------------------------------------- completion
@@ -478,10 +499,12 @@ class Scheduler(object):
         if self.tracer is not None:
             if req.first_token_time is not None:
                 self.tracer.span("request/decode", req.first_token_time,
-                                 req.finish_time, tid=req.rid, rid=req.rid,
+                                 req.finish_time, tid=req.trace.tid,
+                                 rid=req.rid, hop=req.trace.hop(),
                                  tokens=len(req.tokens))
             self.tracer.span("request", req.submit_time, req.finish_time,
-                             tid=req.rid, rid=req.rid,
+                             tid=req.trace.tid, rid=req.rid,
+                             hop=req.trace.hop(),
                              tokens=len(req.tokens), phase="done")
         return req
 
@@ -514,10 +537,12 @@ class Scheduler(object):
         req.finish_time = time.time()
         self.completed[req.rid] = req
         if self.tracer is not None:
-            self.tracer.instant("request/cancelled", tid=req.rid,
-                                rid=req.rid, tokens=len(req.tokens))
+            self.tracer.instant("request/cancelled", tid=req.trace.tid,
+                                rid=req.rid, hop=req.trace.hop(),
+                                tokens=len(req.tokens))
             self.tracer.span("request", req.submit_time, req.finish_time,
-                             tid=req.rid, rid=req.rid,
+                             tid=req.trace.tid, rid=req.rid,
+                             hop=req.trace.hop(),
                              tokens=len(req.tokens), phase="cancelled")
         return True
 
@@ -553,8 +578,9 @@ class Scheduler(object):
             req.replays += 1
             self.queue.appendleft(req)
             if self.tracer is not None:
-                self.tracer.instant("request/replayed", tid=req.rid,
-                                    rid=req.rid, replay=req.replays,
+                self.tracer.instant("request/replayed", tid=req.trace.tid,
+                                    rid=req.rid, hop=req.trace.hop(),
+                                    replay=req.replays,
                                     tokens=len(req.tokens))
         return reqs
 

@@ -78,6 +78,10 @@ class RunResult:
     # latency budget, and their later bit-identical resumes.
     preemptions: int = 0
     preempt_resumes: int = 0
+    # SLO alerting facts (empty without an ``alerts`` manager): every
+    # rising-edge record the manager saw during this run, in firing
+    # order — telemetry/alerts.py's ``fired()`` schema.
+    alerts_fired: list = dataclasses.field(default_factory=list)
 
 
 def _sample_row(lr, req, shed_reason=None):
@@ -139,12 +143,19 @@ class SustainedRunner(object):
     def __init__(self, engine, spec, window_seconds=1.0, max_windows=512,
                  collector=None, max_steps=None, clock=time.time,
                  sleep=time.sleep, chaos_plan=None, chaos_after_s=0.0,
-                 chaos_replica=None):
+                 chaos_replica=None, alerts=None):
         self.engine = engine
         self.spec = spec
         self._clock = clock
         self._sleep = sleep
         self.max_steps = max_steps
+        # Optional telemetry.alerts.AlertManager: evaluated once per
+        # loop iteration (right after the collector tick, so a freshly
+        # closed window is scored immediately) and its rising edges
+        # land in RunResult.alerts_fired. A fleet target usually wires
+        # its own manager into _tick() instead — pass it here too and
+        # evaluate() stays idempotent (windows score once).
+        self.alerts = alerts
         # Chaos mode (module docstring): arm ``chaos_plan`` on the
         # engine once ``chaos_after_s`` run seconds pass. Fault steps
         # count from ARMING, so the plan is written relative to the
@@ -227,7 +238,11 @@ class SustainedRunner(object):
                             sum(1 for _, r, _ in handles
                                 if r is not None and not r.done)))
             self.collector.tick()
+            if self.alerts is not None:
+                self.alerts.evaluate()
         self.collector.sample()   # flush the tail window
+        if self.alerts is not None:
+            self.alerts.evaluate()
         wall = self._clock() - t0
         samples = [_sample_row(lr, req, reason)
                    for lr, req, reason in handles]
@@ -278,4 +293,6 @@ class SustainedRunner(object):
             preemptions=_counter("preemptions")
             - prefix_at_start["preemptions"],
             preempt_resumes=_counter("preempt_resumes")
-            - prefix_at_start["preempt_resumes"])
+            - prefix_at_start["preempt_resumes"],
+            alerts_fired=([] if self.alerts is None
+                          else self.alerts.fired()))

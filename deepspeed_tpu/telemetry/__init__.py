@@ -18,9 +18,31 @@ One dependency-free subsystem every engine emits into:
   ``TensorBoardScalarWriter`` (exporters.py): the read-side. The
   tensorboard extra is imported lazily — this package imports clean on
   a bare interpreter.
+- ``TraceContext`` / ``merged_trace`` / ``validate_trace``
+  (distributed.py): propagated trace context (shared tid + hop
+  counter) and the fleet-wide merge that binds cross-replica hops with
+  Perfetto flow arrows.
+- ``build_autopsy`` / ``worst_requests`` (autopsy.py): the structured
+  "why was this request slow?" answer assembled from the rings.
+- ``AlertRule`` / ``AlertManager`` / ``default_rules`` (alerts.py):
+  declarative SLO burn-rate alerting over the collector's windows.
 
 See docs/OBSERVABILITY.md for the full contract.
 """
+
+from deepspeed_tpu.telemetry.alerts import (
+    AlertManager,
+    AlertRule,
+    default_rules,
+)
+from deepspeed_tpu.telemetry.autopsy import build_autopsy, worst_requests
+from deepspeed_tpu.telemetry.distributed import (
+    TraceContext,
+    TraceError,
+    merged_trace,
+    validate_trace,
+    write_merged_trace,
+)
 
 from deepspeed_tpu.telemetry.exporters import (
     PrometheusEndpoint,
@@ -63,4 +85,14 @@ __all__ = [
     "prometheus_digest",
     "PrometheusEndpoint",
     "TensorBoardScalarWriter",
+    "TraceContext",
+    "TraceError",
+    "merged_trace",
+    "validate_trace",
+    "write_merged_trace",
+    "build_autopsy",
+    "worst_requests",
+    "AlertRule",
+    "AlertManager",
+    "default_rules",
 ]

@@ -100,6 +100,13 @@ class SpanRecorder(object):
 
     # ------------------------------------------------------------ export
 
+    @property
+    def epoch(self):
+        """Wall-clock second this recorder's ts=0 maps to. The fleet
+        merge (telemetry/distributed.py) re-anchors every ring to one
+        shared epoch with this."""
+        return self._t0
+
     def span_counts(self):
         """Exact per-name event counts since construction (survives ring
         wraparound)."""
@@ -138,6 +145,7 @@ class NullRecorder(object):
 
     capacity = 0
     dropped = 0
+    epoch = 0.0
 
     class _Null(object):
         __slots__ = ()

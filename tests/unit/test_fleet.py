@@ -609,3 +609,41 @@ def test_bench_fleet_smoke_report():
     assert extra["fleet_health_at_exit"] == "healthy"
     assert any(v["tokens_emitted"] > 0 for v in extra["mid_stream_at_kill"])
     assert all(c == 1 for c in extra["survivor_compile_counts"].values())
+
+
+# ------------------------------------------------ fleet metrics windows
+
+
+def test_fleet_metrics_reset_brackets_like_a_lone_engine():
+    """Satellite: ``fleet.metrics(reset=True)`` windows the AGGREGATE
+    exactly like a lone engine's metrics — two resets bracket the work
+    between them (bench's warmup scrub) — even though the fleet's own
+    timeseries collector clobbers the per-engine counter windows on
+    every tick, and even for replicas that die between brackets."""
+    cfg, model, params = _shared_model()
+    fleet = fleet_of(model, params, n_replicas=2, start=False)
+    try:
+        ps = prompts_of(cfg, [5, 9, 7])
+        batch_a = [fleet.submit(p, max_new_tokens=4) for p in ps]
+        assert fleet.wait_idle(timeout_s=120.0)
+        m1 = fleet.metrics(reset=True)
+        assert m1["fleet"]["requests_completed"] == len(batch_a)
+        tokens_a = m1["fleet"]["tokens_out"]
+        assert tokens_a == sum(len(fr.tokens) for fr in batch_a) > 0
+        # The window reopened: an immediate read shows nothing.
+        m2 = fleet.metrics()
+        assert m2["fleet"]["requests_completed"] == 0
+        assert m2["fleet"]["tokens_out"] == 0
+        # Second bracket sees ONLY the work since the first reset.
+        batch_b = [fleet.submit(p, max_new_tokens=4) for p in ps[:2]]
+        assert fleet.wait_idle(timeout_s=120.0)
+        m3 = fleet.metrics(reset=True)
+        assert m3["fleet"]["requests_completed"] == len(batch_b)
+        tokens_b = m3["fleet"]["tokens_out"]
+        assert tokens_b == sum(len(fr.tokens) for fr in batch_b) > 0
+        # Cumulative truth never rewinds: the brackets partition it.
+        assert fleet.counters["tokens_out"] == tokens_a + tokens_b
+        assert fleet.counters["requests_completed"] == (
+            len(batch_a) + len(batch_b))
+    finally:
+        fleet.close()

@@ -22,6 +22,7 @@ The contract under test:
    ``import deepspeed_tpu.telemetry`` never needs extras.
 """
 
+import itertools
 import json
 import math
 import sys
@@ -41,10 +42,14 @@ from deepspeed_tpu.telemetry import (
     RecompileDetector,
     SpanRecorder,
     TensorBoardScalarWriter,
+    TraceContext,
+    TraceError,
     annotate,
+    merged_trace,
     profile_window,
     prometheus_digest,
     prometheus_text,
+    validate_trace,
 )
 from tests.unit.test_chunked_prefill import (
     engine_of,
@@ -630,3 +635,128 @@ def test_merged_registry_read_only_escaping_and_kind_conflict():
     assert after[("ds_tpu_tokens_out_total",
                   (("engine", "inference"),
                    ("replica", 'a\\"b\\\\c\\n')))] == 1
+
+
+# ------------------------------------------------ distributed trace parser
+
+
+def _two_site_recorders():
+    """Donor/acceptor recorder pair sharing one TraceContext: one paired
+    handoff flow, one key that never lands (a fallback) — the minimal
+    cross-replica story for the parser-level contract."""
+    ticks = itertools.count()
+
+    def clock():
+        return next(ticks) * 0.001
+
+    donor = SpanRecorder(capacity=64, clock=clock)
+    acceptor = SpanRecorder(capacity=64, clock=clock)
+    ctx = TraceContext(1_000_003, origin="fleet")
+    donor.span("request/prefill", start=clock(), tid=ctx.tid,
+               hop=ctx.hop())
+    donor.instant("request/handoff", tid=ctx.tid, hop=ctx.hop(),
+                  flow_out="handoff/1000003/1")
+    donor.instant("request/handoff", tid=ctx.tid, hop=ctx.hop(),
+                  flow_out="handoff/1000003/fallback")     # never lands
+    acceptor.instant("request/handoff_in", tid=ctx.tid, hop=ctx.hop(),
+                     flow_in="handoff/1000003/1")
+    acceptor.span("request/decode", start=clock(), tid=ctx.tid,
+                  hop=ctx.hop())
+    return donor, acceptor
+
+
+def test_merged_trace_flow_pairs_cross_pid_ts_sorted_at_parser_level():
+    """The merged trace read back the way Perfetto would: JSON
+    round-trip, ts-sorted rows, named process tracks, and exactly one
+    s/f flow pair — shared id and name, start on the donor pid, finish
+    on the acceptor pid at a ts no earlier than the start. The unpaired
+    fallback key draws no arrow."""
+    donor, acceptor = _two_site_recorders()
+    trace = merged_trace({"replica0": donor, "replica1": acceptor})
+    n = validate_trace(trace)
+    events = json.loads(json.dumps(trace))["traceEvents"]
+    assert n == len(events) > 0
+    rows = [e for e in events if e["ph"] != "M"]
+    assert rows == sorted(rows, key=lambda e: e["ts"])
+    pids = {e["pid"]: e["args"]["name"] for e in events
+            if e["ph"] == "M" and e["name"] == "process_name"}
+    assert sorted(pids.values()) == ["replica0", "replica1"]
+    starts = [e for e in events if e["ph"] == "s"]
+    finishes = [e for e in events if e["ph"] == "f"]
+    assert len(starts) == 1 and len(finishes) == 1
+    s, f = starts[0], finishes[0]
+    assert s["id"] == f["id"]
+    assert s["name"] == f["name"] == "flow/handoff"
+    assert s["pid"] != f["pid"]
+    assert pids[s["pid"]] == "replica0" and pids[f["pid"]] == "replica1"
+    assert f["ts"] >= s["ts"] and f["bp"] == "e"
+    # Every request event rides the propagated tid, hop-stamped.
+    hops = [e["args"]["hop"] for e in rows
+            if e["ph"] in ("X", "i") and e["tid"] == 1_000_003]
+    assert sorted(hops) == list(range(5))
+
+
+def test_validate_trace_rejects_malformed_traces():
+    """Each schema clause individually: the validator is the gate
+    write_merged_trace and bin/lint.sh rely on, so every malformation
+    must raise TraceError, not slip into a file Perfetto rejects at
+    2am."""
+    ok = {"name": "a", "ph": "X", "ts": 0.0, "dur": 1.0,
+          "pid": 0, "tid": 1}
+    assert validate_trace({"traceEvents": [ok]}) == 1
+    # Counter tracks are per-process: "C" needs no tid, all else does.
+    assert validate_trace({"traceEvents": [
+        {"name": "queue_depth", "ph": "C", "ts": 0.0, "pid": 0,
+         "args": {"value": 1.0}}]}) == 1
+
+    def bad(events):
+        with pytest.raises(TraceError):
+            validate_trace({"traceEvents": events})
+
+    with pytest.raises(TraceError):
+        validate_trace([ok])                       # not a trace object
+    bad("not a list")
+    bad([{**ok, "ph": "Q"}])                       # unknown phase
+    bad([{**ok, "name": ""}])                      # empty name
+    bad([{k: v for k, v in ok.items() if k != "pid"}])
+    bad([{k: v for k, v in ok.items() if k != "tid"}])
+    bad([{**ok, "ts": "now"}])                     # non-numeric ts
+    bad([{**ok, "ts": 5.0}, ok])                   # ts goes backwards
+    bad([{**ok, "dur": -1.0}])                     # negative span dur
+    bad([{k: v for k, v in ok.items() if k != "dur"}])
+    bad([{"name": "i", "ph": "i", "ts": 0.0, "pid": 0, "tid": 1}])
+    flow = {"name": "flow/h", "ph": "s", "id": 1, "ts": 0.0,
+            "pid": 0, "tid": 1}
+    bad([{k: v for k, v in flow.items() if k != "id"}])
+    bad([flow])                                    # start, no finish
+    bad([flow, {**flow, "ts": 1.0}])               # duplicate start
+    bad([{**flow, "ph": "f"}])                     # finish, no start
+    bad([flow, {**flow, "ph": "f", "name": "flow/x", "ts": 1.0}])
+    bad([{**flow, "ph": "f"}, {**flow, "ts": 1.0}])   # finish < start
+    # The well-formed pair still passes with the same parser.
+    assert validate_trace({"traceEvents": [
+        flow, {**flow, "ph": "f", "bp": "e", "ts": 1.0}]}) == 2
+
+
+def test_trace_spans_dropped_rides_merge_with_replica_label():
+    """Satellite: span-ring overflow is a live per-replica series. An
+    engine with a tiny trace ring overflows during one run; the gauge
+    reads the recorder's exact drop count bare, through Prometheus, and
+    through a MergedRegistry with the replica label injected — so a
+    truncated autopsy is visible from the same scrape as the alert."""
+    cfg, model, params = make_model()
+    eng = engine_of(model, params, trace_ring=8)
+    for p in prompts_of(cfg, [5, 9, 7]):
+        eng.submit(p, max_new_tokens=4)
+    eng.run()
+    dropped = eng.tracer.dropped
+    assert dropped > 0 and len(eng.tracer.events()) == 8
+    assert eng.telemetry.snapshot()["trace_spans_dropped"] == dropped
+    kinds, samples = _parse_prom(eng.prometheus())
+    assert kinds["ds_tpu_trace_spans_dropped"] == "gauge"
+    lbl = (("engine", "inference"),)
+    assert samples[("ds_tpu_trace_spans_dropped", lbl)] == dropped
+    _, merged = _parse_prom(prometheus_text(
+        MergedRegistry({0: eng.telemetry})))
+    lbl = (("engine", "inference"), ("replica", "0"))
+    assert merged[("ds_tpu_trace_spans_dropped", lbl)] == dropped
