@@ -456,6 +456,10 @@ def _emit(result):
     # trajectory records whether the tree was contract-clean when the
     # number was earned.
     result["extra"].setdefault("analysis_findings", _analysis_summary())
+    # Which ModelAdapter produced this artifact. Serving measurements
+    # set it from engine.metrics(); everything else measures the GPT-2
+    # source directly, which the GPT-2 adapter wraps unchanged.
+    result["extra"].setdefault("adapter", "gpt2")
     # Observability plane state for this measurement (PR 14): span counts
     # per recorder site, ring drops, and any SLO alerts that fired.
     if _TRACE_SUMMARY is not None:
@@ -899,7 +903,8 @@ def _decode_attention_probe(engine, reps=10, s=1):
 
 def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
                      spec_decode=True, int8_kv=True, prefix_cache=True,
-                     host_offload=True):
+                     host_offload=True, sparse_decode=True,
+                     expert_parallel=True):
     """Continuous-batching serving benchmark (deepspeed_tpu/inference/).
 
     A synthetic Poisson request stream plays against the slotted engine:
@@ -927,7 +932,15 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
     (docs/INFERENCE.md); the ``--no-int8-kv`` / ``--no-prefix-cache`` /
     ``--no-host-offload`` A/Bs suffix the metric name so hierarchy-on
     and hierarchy-off series never mix. The hierarchy rides the chunked
-    path only — the legacy A/B runs with it off."""
+    path only — the legacy A/B runs with it off. ``sparse_decode`` /
+    ``expert_parallel`` are the adapter-feature A/B arms
+    (``--no-sparse-decode`` / ``--no-expert-parallel``, suffixed
+    ``_nosparsedecode`` / ``_noexpertparallel``): both keys ride the
+    serving config into ``ModelAdapter.bind``, where adapters WITH the
+    feature honor them (LongContextAdapter drops its threshold,
+    MoEAdapter replicates its expert stacks) and the stock GPT-2
+    adapter ignores them — the flag records which arm produced the
+    artifact either way."""
     import jax
 
     import deepspeed_tpu as deepspeed
@@ -964,6 +977,8 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
     serve_cfg["int8_kv"] = int8_on
     serve_cfg["prefix_cache"] = prefix_on
     serve_cfg["host_offload"] = offload_on
+    serve_cfg["sparse_decode"] = bool(sparse_decode)
+    serve_cfg["expert_parallel"] = bool(expert_parallel)
     if prefix_on and not on_tpu:
         # Tiny-plane smoke sizing: prefixes shorter than the 64-token
         # default so the prefix plane stays a sliver of the smoke pool.
@@ -1084,6 +1099,10 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
         name += "_noprefixcache"
     if not host_offload:
         name += "_nohostoffload"
+    if not sparse_decode:
+        name += "_nosparsedecode"
+    if not expert_parallel:
+        name += "_noexpertparallel"
     _note_trace(engine)
     return {
         "metric": name,
@@ -1116,6 +1135,9 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
             "int8_kv": int8_on,
             "prefix_cache": prefix_on,
             "host_offload": offload_on,
+            "adapter": m.get("adapter"),
+            "sparse_decode": bool(sparse_decode),
+            "expert_parallel": bool(expert_parallel),
             "prefix_hit_rate": m.get("prefix_hit_rate"),
             "kv_bytes_per_slot": m.get("kv_bytes_per_slot"),
             "kv_bytes_aliased": m.get("kv_bytes_aliased"),
@@ -1142,14 +1164,17 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
 
 def main_serve(smoke=False, flash_decode=None, chunked_prefill=True,
                spec_decode=True, int8_kv=True, prefix_cache=True,
-               host_offload=True):
+               host_offload=True, sparse_decode=True,
+               expert_parallel=True):
     if not smoke:
         _require_tpu_or_exit()
     _emit(_measure_serving(smoke=smoke, flash_decode=flash_decode,
                            chunked_prefill=chunked_prefill,
                            spec_decode=spec_decode, int8_kv=int8_kv,
                            prefix_cache=prefix_cache,
-                           host_offload=host_offload))
+                           host_offload=host_offload,
+                           sparse_decode=sparse_decode,
+                           expert_parallel=expert_parallel))
     return 0
 
 
@@ -2100,6 +2125,12 @@ def _dispatch(argv):
     # hierarchy-off sides of the KV-memory-hierarchy A/Bs (default True
     # each; metric suffixed _noint8kv / _noprefixcache / _nohostoffload
     # so the series never mix).
+    # --no-sparse-decode / --no-expert-parallel: the adapter-feature
+    # A/B arms (default True each; metric suffixed _nosparsedecode /
+    # _noexpertparallel so the series never mix). The keys ride the
+    # serving config into ModelAdapter.bind — adapters with the feature
+    # honor them, the stock GPT-2 adapter records the arm and ignores
+    # them (docs/ADAPTERS.md).
     # --no-prefix-affinity: the directory-off side of the fleet
     # prefix-affinity A/B (--fleet/--fleet-smoke only; metric suffixed
     # _noprefixaffinity) — per-replica caches stay on, fleet routing
@@ -2115,6 +2146,8 @@ def _dispatch(argv):
     int8_kv = "--no-int8-kv" not in argv
     prefix_cache = "--no-prefix-cache" not in argv
     host_offload = "--no-host-offload" not in argv
+    sparse_decode = "--no-sparse-decode" not in argv
+    expert_parallel = "--no-expert-parallel" not in argv
     prefix_affinity = "--no-prefix-affinity" not in argv
     disagg_ab = "--disagg" in argv or "--no-disagg" in argv
     disagg_on = "--no-disagg" not in argv
@@ -2148,12 +2181,16 @@ def _dispatch(argv):
         return main_serve(smoke=True, flash_decode=flash_decode,
                           chunked_prefill=chunked, spec_decode=spec,
                           int8_kv=int8_kv, prefix_cache=prefix_cache,
-                          host_offload=host_offload)
+                          host_offload=host_offload,
+                          sparse_decode=sparse_decode,
+                          expert_parallel=expert_parallel)
     if "--serve" in argv:
         return main_serve(flash_decode=flash_decode,
                           chunked_prefill=chunked, spec_decode=spec,
                           int8_kv=int8_kv, prefix_cache=prefix_cache,
-                          host_offload=host_offload)
+                          host_offload=host_offload,
+                          sparse_decode=sparse_decode,
+                          expert_parallel=expert_parallel)
     if "--sweep" in argv:
         return main_sweep()
     if "--xl-compute" in argv:

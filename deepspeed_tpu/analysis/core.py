@@ -22,7 +22,8 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from . import annotations as _ann
 
-RULE_NAMES = ("HOSTSYNC", "RECOMPILE", "DONATION", "DETERMINISM", "THREADRACE")
+RULE_NAMES = ("HOSTSYNC", "RECOMPILE", "DONATION", "DETERMINISM", "THREADRACE",
+              "ADAPTER")
 
 # ``# graftlint: disable=RULE`` or ``disable=RULE1,RULE2`` or ``disable=all``.
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z_][A-Za-z0-9_,\s]*)")

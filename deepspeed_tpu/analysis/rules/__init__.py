@@ -1,6 +1,6 @@
 """graftlint rule registry. Each rule is ``check(ctx, config) -> findings``."""
 
-from . import determinism, donation, hostsync, recompile, threadrace
+from . import adapter, determinism, donation, hostsync, recompile, threadrace
 
 RULES = {
     "HOSTSYNC": hostsync.check,
@@ -8,6 +8,7 @@ RULES = {
     "DONATION": donation.check,
     "DETERMINISM": determinism.check,
     "THREADRACE": threadrace.check,
+    "ADAPTER": adapter.check,
 }
 
 __all__ = ["RULES"]

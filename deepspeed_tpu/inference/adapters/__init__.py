@@ -1,0 +1,14 @@
+"""Model adapters — the engine<->model protocol and its implementations.
+
+See protocol.py for the contract and docs/ADAPTERS.md for how to bring
+a new model. The graftlint ADAPTER rule keeps ``models.generation``
+imports inside ``inference/`` confined to ``adapters/gpt2.py``.
+"""
+
+from deepspeed_tpu.inference.adapters.protocol import ModelAdapter
+from deepspeed_tpu.inference.adapters.gpt2 import GPT2Adapter
+from deepspeed_tpu.inference.adapters.moe import MoEAdapter, MoECfg
+from deepspeed_tpu.inference.adapters.longcontext import LongContextAdapter
+
+__all__ = ["ModelAdapter", "GPT2Adapter", "MoEAdapter", "MoECfg",
+           "LongContextAdapter"]

@@ -1,0 +1,305 @@
+"""MoEAdapter — a small mixture-of-experts transformer behind the protocol.
+
+A self-contained MoE decode forward (same per-row frontier cache
+mechanics as GPT-2: write-at-frontier, global-position causal mask,
+stale-cache rule) whose MLP is a Switch-style top-1 MoE routed through
+``moe/sharded_moe.top1gating``. Dispatch and combine are EINSUMS over a
+[tokens, experts, capacity] tensor — with the stacked expert params
+(leading ``[n_experts]`` axis, parameter paths ``.../experts/...``)
+sharded over the mesh's 'model' axis by the standard TP rules
+(parallel/mesh.py DEFAULT_TP_RULES), XLA's SPMD partitioner lowers them
+into the token all-to-alls of expert parallelism automatically.
+
+FAILOVER INVARIANT (per-row independence — protocol.py): capacity-based
+token dropping couples rows through the cumsum position race, which
+would break the fleet's bit-identical crash replay (a replayed request
+lands next to different slot neighbors). The serving default therefore
+pins capacity to the FULL token count (``capacity_factor=0`` means
+"factor = n_experts", so ``cap == tokens`` and nothing ever drops):
+each row's output then depends only on its own token — gate weights are
+per-token, and an expert FFN row's value is independent of which
+capacity slot it occupies. Routing itself is deterministic
+(``noise_rng=None``), so the positional fold_in(seed, pos) sampling rng
+survives expert routing unchanged. A nonzero ``capacity_factor``
+re-enables dropping for load studies but voids the replay invariant.
+
+Telemetry rides the pool's ``aux_`` channel: per-expert dispatch counts,
+routed and dropped token totals accumulate on-device in pool-resident
+``aux_moe_*`` arrays (threaded through every jitted program, fetched by
+``harvest_snapshot``), and ``observe`` publishes them as
+``moe_expert_load{expert=i}`` / ``moe_capacity_factor`` /
+``moe_drop_rate`` gauges — merged fleet-wide by MergedRegistry. Counts
+include every slot the program touches (idle slots decode garbage by
+design), so load gauges read as per-step program load, not per-request
+token counts.
+
+Supports plain fp KV planes only: the int8 and prefix hierarchy tiers
+and flash decode are GPT-2-path features (a cache carrying them raises
+at trace time).
+"""
+
+import collections
+import dataclasses
+from typing import ClassVar
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.analysis.annotations import hot_path
+from deepspeed_tpu.inference.adapters.gpt2 import GPT2Adapter
+from deepspeed_tpu.moe import sharded_moe
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+# Hashable static spec — the leading fields mirror _GenCfg (the KV pool,
+# mesh sharding helpers and engine metrics read exactly those names).
+MoECfg = collections.namedtuple(
+    "MoECfg",
+    "n_layer n_head n_embd n_positions dtype layer_norm_epsilon "
+    "use_flash_decode vocab_size n_experts d_ff capacity_factor")
+
+
+def _ln(x, p, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _dense(x, p):
+    return x @ p["kernel"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+def _moe_mlp(blk, h, cfg):
+    """Top-1 routed expert MLP over ``h`` [B, S, C]. Returns (out
+    [B, S, C], per-expert dispatch counts [E] fp32, dropped fp32)."""
+    B, S, C = h.shape
+    tok = h.reshape(B * S, C)
+    router = blk["router"]
+    logits = (tok.astype(jnp.float32) @ router["kernel"].astype(jnp.float32)
+              + router["bias"].astype(jnp.float32))            # [T, E]
+    factor = cfg.capacity_factor or float(cfg.n_experts)
+    # noise_rng=None: routing is deterministic — required for the
+    # fleet's bit-identical replay (module docstring).
+    _, combine, dispatch, exp_counts = sharded_moe.top1gating(
+        logits, capacity_factor=factor, min_capacity=1, noise_rng=None)
+    exp = blk["experts"]
+    disp = jnp.einsum("tec,tm->ecm", dispatch.astype(h.dtype), tok)
+    hh = jnp.einsum("ecm,emf->ecf", disp, exp["w1"].astype(h.dtype))
+    hh = jax.nn.gelu(hh + exp["b1"][:, None, :].astype(h.dtype),
+                     approximate=True)
+    eo = jnp.einsum("ecf,efm->ecm", hh, exp["w2"].astype(h.dtype))
+    eo = eo + exp["b2"][:, None, :].astype(h.dtype)
+    out = jnp.einsum("tec,ecm->tm", combine.astype(h.dtype), eo)
+    counts = exp_counts.astype(jnp.float32)
+    dropped = jnp.float32(B * S) - jnp.sum(counts)
+    return out.reshape(B, S, C), counts, dropped
+
+
+@hot_path
+def _moe_forward(params, cfg, ids, cache, last_only=False):
+    """ids [B, S], row b starting at cache['pos'][b]; returns
+    (fp32 logits, advanced cache). Same frontier/mask mechanics as
+    generation._forward — rows at different sequence lengths share one
+    program, positions past the frontier are masked garbage."""
+    B, S = ids.shape
+    nh, hd = cfg.n_head, cfg.n_embd // cfg.n_head
+    if cache["k"].dtype == jnp.int8 or "pk" in cache:
+        raise ValueError(
+            "MoEAdapter supports plain fp KV planes only (no int8 / "
+            "prefix hierarchy tiers)")
+    pos = cache["pos"]
+    max_len = cache["k"].shape[3]
+    eps = cfg.layer_norm_epsilon
+    wte = params["wte"].astype(cfg.dtype)
+    q_pos = pos[:, None] + jnp.arange(S)[None]                 # [B, S]
+    pe = params["wpe"].astype(cfg.dtype)[q_pos]
+    x = wte[ids] + pe
+    k_pos = jnp.arange(max_len)
+    mask = k_pos[None, None, :] <= q_pos[:, :, None]           # [B, S, T]
+    neg = jnp.finfo(jnp.float32).min
+    k_cache, v_cache = cache["k"], cache["v"]
+    aux_load = cache["aux_moe_load"]
+    aux_routed = cache["aux_moe_routed"]
+    aux_dropped = cache["aux_moe_dropped"]
+
+    def write_rows(cache_l, new):
+        return jax.vmap(lambda c, n, p: jax.lax.dynamic_update_slice(
+            c, n, (0, p, 0)))(cache_l, new, pos)
+
+    for i in range(cfg.n_layer):
+        blk = params["h_{}".format(i)]
+        h = _ln(x, blk["ln_1"], eps)
+        qkv = _dense(h, blk["attn"]["c_attn"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        k_cache = k_cache.at[i].set(write_rows(k_cache[i], k))
+        v_cache = v_cache.at[i].set(write_rows(v_cache[i], v))
+        att = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache[i]).astype(
+            jnp.float32) / jnp.sqrt(hd)
+        att = jnp.where(mask[:, None], att, neg)
+        att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
+        y = jnp.einsum("bhqk,bhkd->bhqd", att, v_cache[i])
+        y = y.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_embd)
+        x = x + _dense(y, blk["attn"]["c_proj"])
+        h = _ln(x, blk["ln_2"], eps)
+        m, counts, dropped = _moe_mlp(blk, h, cfg)
+        x = x + m
+        aux_load = aux_load + counts
+        aux_routed = aux_routed + jnp.float32(B * S)
+        aux_dropped = aux_dropped + dropped
+
+    if last_only:
+        x = x[:, -1:]
+    x = _ln(x, params["ln_f"], eps)
+    logits = jnp.einsum("bsc,vc->bsv", x.astype(jnp.float32),
+                        params["wte"].astype(jnp.float32))
+    return logits, dict(cache, k=k_cache, v=v_cache, pos=pos + S,
+                        aux_moe_load=aux_load, aux_moe_routed=aux_routed,
+                        aux_moe_dropped=aux_dropped)
+
+
+def init_params(rng, cfg, init_scale=0.02):
+    """Random servable parameter tree for an ``MoECfg``. Layout mirrors
+    the GPT-2 tree (ln_1/attn/ln_2 per block) with the MLP replaced by
+    ``router`` ([C, E] gate) + ``experts`` (stacked [E, ...] FFN params —
+    the path DEFAULT_TP_RULES shards over 'model')."""
+    C, F, E = cfg.n_embd, cfg.d_ff, cfg.n_experts
+    keys = iter(jax.random.split(rng, 4 + 6 * cfg.n_layer))
+
+    def norm(key, shape):
+        return init_scale * jax.random.normal(key, shape, jnp.float32)
+
+    params = {
+        "wte": norm(next(keys), (cfg.vocab_size, C)),
+        "wpe": norm(next(keys), (cfg.n_positions, C)),
+        "ln_f": {"scale": jnp.ones((C,), jnp.float32),
+                 "bias": jnp.zeros((C,), jnp.float32)},
+    }
+    for i in range(cfg.n_layer):
+        params["h_{}".format(i)] = {
+            "ln_1": {"scale": jnp.ones((C,), jnp.float32),
+                     "bias": jnp.zeros((C,), jnp.float32)},
+            "attn": {
+                "c_attn": {"kernel": norm(next(keys), (C, 3 * C)),
+                           "bias": jnp.zeros((3 * C,), jnp.float32)},
+                "c_proj": {"kernel": norm(next(keys), (C, C)),
+                           "bias": jnp.zeros((C,), jnp.float32)},
+            },
+            "ln_2": {"scale": jnp.ones((C,), jnp.float32),
+                     "bias": jnp.zeros((C,), jnp.float32)},
+            "router": {"kernel": norm(next(keys), (C, E)),
+                       "bias": jnp.zeros((E,), jnp.float32)},
+            "experts": {"w1": norm(next(keys), (E, C, F)),
+                        "b1": jnp.zeros((E, F), jnp.float32),
+                        "w2": norm(next(keys), (E, F, C)),
+                        "b2": jnp.zeros((E, C), jnp.float32)},
+        }
+    return params
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEAdapter(GPT2Adapter):
+    """Expert-parallel MoE decode. Subclasses GPT2Adapter ONLY for the
+    model-agnostic token-space utilities (ngram_draft / accept_counts —
+    spec-decode drafting never touches model weights) and the cache_spec
+    plumbing; every forward is the MoE program above."""
+
+    expert_parallel: bool = True
+    name: ClassVar[str] = "moe"
+
+    @classmethod
+    def from_config(cls, vocab_size=256, n_layer=2, n_head=2, n_embd=32,
+                    n_positions=512, n_experts=4, d_ff=None,
+                    capacity_factor=0.0, dtype=jnp.float32,
+                    layer_norm_epsilon=1e-5):
+        """``capacity_factor=0`` pins capacity to the full token count
+        (no drops — the serving/failover default, module docstring)."""
+        return cls(MoECfg(int(n_layer), int(n_head), int(n_embd),
+                          int(n_positions), dtype,
+                          float(layer_norm_epsilon), False,
+                          int(vocab_size), int(n_experts),
+                          int(d_ff or 4 * n_embd),
+                          float(capacity_factor)))
+
+    def init_params(self, rng, init_scale=0.02):
+        return init_params(rng, self.gcfg, init_scale)
+
+    def bind(self, config, mesh=None):
+        # use_flash_decode is ignored: the MoE forward has no flash path
+        # (gcfg.use_flash_decode stays False so the engine's metrics and
+        # plane padding read the truth).
+        if config is not None:
+            ep = bool(getattr(config, "expert_parallel", True))
+            if ep != self.expert_parallel:
+                return dataclasses.replace(self, expert_parallel=ep)
+        return self
+
+    def init_cache(self, batch, max_len, dtype=None):
+        cfg = self.gcfg
+        dtype = dtype or cfg.dtype
+        hd = cfg.n_embd // cfg.n_head
+        shape = (cfg.n_layer, batch, cfg.n_head, max_len, hd)
+        return dict({"k": jnp.zeros(shape, dtype),
+                     "v": jnp.zeros(shape, dtype),
+                     "pos": jnp.zeros((batch,), jnp.int32)},
+                    **self.aux_state())
+
+    def aux_state(self):
+        return {"aux_moe_load": jnp.zeros((self.gcfg.n_experts,),
+                                          jnp.float32),
+                "aux_moe_routed": jnp.zeros((), jnp.float32),
+                "aux_moe_dropped": jnp.zeros((), jnp.float32)}
+
+    @hot_path
+    def prefill_append(self, params, ids, cache, n_valid=None):
+        pos0 = cache["pos"]
+        logits, cache = _moe_forward(params, self.gcfg, ids, cache)
+        if n_valid is not None:
+            cache = dict(cache, pos=pos0 + n_valid)
+        return logits, cache
+
+    @hot_path
+    def decode_step(self, params, tok, cache):
+        logits, cache = _moe_forward(params, self.gcfg, tok[:, None], cache)
+        return logits[:, 0], cache
+
+    @hot_path
+    def verify_forward(self, params, ids, cache):
+        pos0 = cache["pos"]
+        logits, cache = _moe_forward(params, self.gcfg, ids, cache)
+        return logits, dict(cache, pos=pos0)
+
+    def param_shardings(self, mesh, params):
+        rules = mesh_lib.DEFAULT_TP_RULES
+        if not self.expert_parallel:
+            # A/B flag (bench --no-expert-parallel): experts replicate,
+            # the Megatron attn/mlp rules still apply.
+            rules = tuple(r for r in rules if "experts" not in r[0])
+        param_sh, _, _ = mesh_lib.zero_shardings(mesh, params, stage=0,
+                                                 tp_rules=rules)
+        return param_sh
+
+    def observe(self, snap, registry):
+        load = snap.get("aux_moe_load")
+        if load is None:
+            return
+        load = [float(v) for v in load]
+        for i, v in enumerate(load):
+            registry.gauge("moe_expert_load", expert=str(i)).set(v)
+        total = sum(load)
+        routed = float(snap.get("aux_moe_routed", 0.0))
+        dropped = float(snap.get("aux_moe_dropped", 0.0))
+        registry.gauge("moe_tokens_routed").set(routed)
+        registry.gauge("moe_tokens_dropped").set(dropped)
+        registry.gauge("moe_drop_rate").set(
+            dropped / routed if routed else 0.0)
+        registry.gauge("moe_capacity_factor").set(
+            self.gcfg.capacity_factor or float(self.gcfg.n_experts))
+        if total:
+            # max/mean dispatch ratio: 1.0 is perfectly balanced,
+            # n_experts is fully collapsed routing.
+            registry.gauge("moe_expert_load_imbalance").set(
+                max(load) * len(load) / total)

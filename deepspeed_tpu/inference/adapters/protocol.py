@@ -1,0 +1,127 @@
+"""ModelAdapter — the complete engine<->model contract.
+
+The serving engine (inference/engine.py) is model-agnostic: every model
+computation it performs — cache allocation, chunked prefill, the decode
+step, speculative verify, drafting — goes through exactly this surface.
+No other model import is reachable from hot-path engine code; the
+graftlint ADAPTER rule (analysis/rules/adapter.py) enforces that
+``models.generation`` is imported inside ``inference/`` ONLY by
+``adapters/gpt2.py``.
+
+Contract requirements (pinned by tests/unit/test_adapters.py, the
+conformance kit every adapter must pass):
+
+- Adapters are IMMUTABLE and HASHABLE: an adapter instance is the static
+  argument of every jitted engine program, so equality/hash must reflect
+  the full compiled-behavior configuration (frozen dataclasses over
+  hashable config tuples). One adapter => one compiled mixed-step program
+  per engine (compile_count == 1).
+- The cache is a dict of arrays with per-row frontier ``pos`` [B]; k/v
+  planes are [layers, B, heads, plane_len, head_dim] so the KV pool,
+  hierarchy (int8 / prefix tiers, host offload) and handoff machinery
+  compose unchanged. Extra model state MUST use ``aux_``-prefixed keys:
+  the pool threads them through every program, the hierarchy's
+  capture/restore skips them (they are not per-slot), and
+  ``harvest_snapshot`` fetches them for ``observe``.
+- Positions past a row's frontier may hold garbage that is masked or
+  overwritten before the frontier reaches them (the stale-cache rule) —
+  this is what makes speculative rollback "don't advance pos" and chunked
+  prefill's pad columns free.
+- Per-row INDEPENDENCE: row b's logits depend only on row b's tokens and
+  frontier. This is what the fleet's crash-replay bit-identity invariant
+  (RESILIENCE.md) rests on — replayed requests land in different slots
+  next to different neighbors and must emit the same stream. An adapter
+  with cross-row coupling (e.g. MoE capacity dropping) must neutralize it
+  (see adapters/moe.py) or document that it breaks the invariant.
+"""
+
+
+class ModelAdapter:
+    """Base protocol. Engines call ONLY these methods on the model side.
+
+    Required surface: ``cache_spec`` / ``init_cache`` / ``prefill_append``
+    / ``decode_step`` / ``verify_forward`` (plus the drafting pair for
+    speculative decode). Optional hooks (``bind``, ``aux_state``,
+    ``observe``, ``param_shardings``) have inert defaults.
+    """
+
+    name = "adapter"
+
+    # ------------------------------------------------------------------
+    # required surface
+    # ------------------------------------------------------------------
+    def cache_spec(self):
+        """Hashable shape/dtype spec of the KV cache: an object with
+        ``n_layer / n_head / n_embd / n_positions / dtype /
+        layer_norm_epsilon / use_flash_decode`` attributes (the
+        ``_GenCfg`` shape the KV pool and mesh sharding helpers key on).
+        Must be stable for the adapter's lifetime — it is part of the
+        jit static key."""
+        raise NotImplementedError
+
+    def init_cache(self, batch, max_len, dtype=None):
+        """Zeroed cache dict for ``batch`` rows of plane length
+        ``max_len``: k/v planes + per-row ``pos`` [B] frontier."""
+        raise NotImplementedError
+
+    def prefill_append(self, params, ids, cache, n_valid=None):
+        """Append ``ids`` [B, S] at each row's frontier (chunked-prefill
+        primitive). ``n_valid`` [B] marks leading real columns; the
+        frontier advances by ``n_valid`` (default S). Returns
+        (fp32 logits [B, S, V], advanced cache)."""
+        raise NotImplementedError
+
+    def decode_step(self, params, tok, cache):
+        """Advance every row one token: feed ``tok`` [B] at each row's
+        frontier. Returns (fp32 logits [B, V], advanced cache)."""
+        raise NotImplementedError
+
+    def verify_forward(self, params, ids, cache):
+        """Score ``ids`` [B, S] at each row's frontier WITHOUT advancing
+        it (speculative verify; rollback = not moving ``pos``). Returns
+        (fp32 logits [B, S, V], cache with pos unchanged)."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # drafting surface (speculative decode)
+    # ------------------------------------------------------------------
+    def ngram_draft(self, toks, pos, n, k):
+        """Propose [B, k] draft tokens from the token ring ``toks`` [B, T]
+        at frontiers ``pos`` [B] (prompt-lookup self-speculation)."""
+        raise NotImplementedError
+
+    def accept_counts(self, draft, choices, ok=None):
+        """[B] accepted-token counts in 1..K+1 given drafts [B, K] and
+        the model's verify choices [B, K+1]."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # optional hooks
+    # ------------------------------------------------------------------
+    def bind(self, config, mesh=None):
+        """Return the adapter specialized to an engine's InferenceConfig
+        and mesh (e.g. honor ``config.use_flash_decode`` /
+        ``config.sparse_decode`` / ``config.expert_parallel``, pick the
+        ring fallback when the mesh carries a 'seq' axis). Must return an
+        adapter — ``self`` when nothing changes."""
+        return self
+
+    def aux_state(self):
+        """Extra pool-resident model state: a dict of ``aux_``-prefixed
+        arrays merged into the KV pool at build time and threaded through
+        every program (e.g. MoE per-expert load counters). NOT per-slot:
+        hierarchy capture/restore skips these keys."""
+        return {}
+
+    def observe(self, snap, registry):
+        """Publish adapter gauges from a harvest snapshot (the host copy
+        of pool state, including ``aux_`` keys) into a telemetry
+        MetricsRegistry. Called once per engine step batch — keep it
+        cheap and host-only."""
+        return None
+
+    def param_shardings(self, mesh, params):
+        """Optional NamedSharding pytree for ``params`` on ``mesh``; None
+        defers to the engine's default (zero_shardings stage 0 with the
+        standard tensor-parallel rules)."""
+        return None

@@ -49,6 +49,8 @@ INFERENCE_DEFAULTS = {
     "swap_slots": 8,
     "hbm_budget_bytes": None,
     "role": "mixed",
+    "sparse_decode": True,
+    "expert_parallel": True,
 }
 
 
@@ -213,6 +215,17 @@ class InferenceConfig:
     # lax.cond-skipped when unused), so compile_count stays 1 either
     # way. Requires chunked_prefill.
     role: str = "mixed"
+    # --- Model-adapter policy switches (inference/adapters/) ------------
+    # Honored by ``ModelAdapter.bind`` at engine construction; inert for
+    # adapters without the corresponding feature (GPT2Adapter ignores
+    # both). False disables LongContextAdapter's block-sparse decode
+    # window — attention stays dense at every position (the bench
+    # --no-sparse-decode A/B arm).
+    sparse_decode: bool = True
+    # False strips the expert-sharding TP rule so MoE expert stacks
+    # replicate instead of sharding over 'model' (the bench
+    # --no-expert-parallel A/B arm).
+    expert_parallel: bool = True
 
     def __post_init__(self):
         if self.max_slots < 1:

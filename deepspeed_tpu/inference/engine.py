@@ -3,13 +3,19 @@
 Chunked prefill (the default, Sarathi-Serve-style — Agrawal et al.,
 OSDI'24) serves every request mix with ONE jitted program:
 
+Every model computation goes through the ModelAdapter protocol
+(inference/adapters/protocol.py) — the engine never imports a model
+module (graftlint ADAPTER rule); the adapter instance IS the jit static
+argument, so GPT-2, MoE and long-context workloads each get their own
+single compiled program through identical engine code.
+
 - MIXED STEP (one compile, ever): a PREFILL LANE appends one
   ``prefill_chunk``-token slice of ONE slot's prompt at its cursor
-  (``models.generation.append_forward`` — causal against the slot's
+  (the adapter's ``prefill_append`` — causal against the slot's
   existing cache, k/v written at a TRACED frontier), sampling the
   request's first token when the slice is the prompt's last; then the
   DECODE LANE advances ALL slots ``chunk_size`` tokens via one
-  ``lax.scan`` over ``models.generation.decode_step``. Slot index,
+  ``lax.scan`` over the adapter's ``decode_step``. Slot index,
   cursor, slice length and every sampling param are traced, so any
   prompt-length mix runs the same program — no per-bucket compiles, and
   decode never stalls behind a long prompt (bounded TTFT instead of
@@ -19,7 +25,7 @@ Speculative decoding (``spec_decode`` — Leviathan et al., ICML'23, in
 its draft-model-free prompt-lookup form) swaps the decode lane's scan
 body for a DRAFT/VERIFY step, still inside the same single program: each
 slot drafts ``spec_k`` tokens by n-gram lookup over its own token ring
-(``models.generation.ngram_draft`` — pure device work, no host sync),
+(the adapter's ``ngram_draft`` — pure device work, no host sync),
 one ``verify_forward`` scores all ``spec_k+1`` positions at the slot's
 frontier, and the longest draft prefix agreeing with the model's own
 choices is accepted — 1..spec_k+1 tokens per slot per step. Rollback of
@@ -44,7 +50,7 @@ admit queued requests into free slots, feed the oldest prefilling
 slot's next prompt chunk, decode, harvest emitted tokens in ONE batched
 host sync, evict finished slots. Under greedy decoding the emitted
 tokens are token-identical to sequential ``generate`` calls — all paths
-drive the same decode step program (models/generation.py).
+drive the same adapter ``decode_step`` primitive.
 
 CRASH-ONLY serving (docs/RESILIENCE.md): the host-side request records
 are the durable truth and the device pool is disposable. A fatal step
@@ -112,8 +118,8 @@ from deepspeed_tpu.inference.kv_pool import (
     slot_cache_view,
     write_slot_cache,
 )
+from deepspeed_tpu.inference.adapters import GPT2Adapter
 from deepspeed_tpu.inference.scheduler import QueueFull, Scheduler
-from deepspeed_tpu.models import generation
 from deepspeed_tpu.parallel import mesh as mesh_lib
 from deepspeed_tpu.telemetry import (
     MetricsRegistry,
@@ -222,14 +228,16 @@ class _CounterBank(object):
 
 
 @hot_path
-def _prefill_program(params, gcfg, pool, prompt, prompt_len, slot,
+def _prefill_program(params, adapter, pool, prompt, prompt_len, slot,
                      max_new, eos_id, temp, top_k, seed):
     """LEGACY path: admit one request into ``slot`` with a whole-prompt
     pass. ``prompt`` is [1, bucket] (padded right; pad ids are arbitrary
     — their logits are never read and their k/v writes sit beyond the
-    frontier). Returns (pool', first_token)."""
+    frontier). Returns (pool', first_token). The explicit ``pos``
+    install below overrides the append's own frontier advance, so the
+    adapter's prefill primitive serves both entry modes."""
     cache = slot_cache_view(pool, slot, jnp.zeros((1,), jnp.int32))
-    logits, cache = generation._forward(params, gcfg, prompt, cache)
+    logits, cache = adapter.prefill_append(params, prompt, cache)
     last = logits[0, prompt_len - 1]                    # true last row [V]
     first = _sample_rows(last[None], temp[None], top_k[None], seed[None],
                          prompt_len[None])[0]
@@ -246,7 +254,7 @@ def _prefill_program(params, gcfg, pool, prompt, prompt_len, slot,
 
 
 @hot_path
-def _decode_chunk_program(params, gcfg, chunk, pool):
+def _decode_chunk_program(params, adapter, chunk, pool):
     """Advance every ACTIVE slot ``chunk`` tokens in one scan. Returns
     (pool', tokens [chunk, slots], valid [chunk, slots]) — valid[t, s]
     marks slot s as active at step t, i.e. tokens[t, s] belongs to its
@@ -258,8 +266,8 @@ def _decode_chunk_program(params, gcfg, chunk, pool):
     def step(pool, _):
         was_active = pool["active"]
         old_pos = pool["pos"]
-        logits, cache = generation.decode_step(
-            params, gcfg, pool["last_tok"], cache_view(pool))
+        logits, cache = adapter.decode_step(
+            params, pool["last_tok"], cache_view(pool))
         nxt = _sample_rows(logits, pool["temp"], pool["top_k"],
                            pool["seed"], cache["pos"])
         nxt = jnp.where(was_active, nxt, pool["last_tok"])
@@ -279,7 +287,7 @@ def _decode_chunk_program(params, gcfg, chunk, pool):
 
 
 @hot_path
-def _spec_decode_chunk_program(params, gcfg, chunk, spec_k, spec_ngram,
+def _spec_decode_chunk_program(params, adapter, chunk, spec_k, spec_ngram,
                                pool):
     """The decode lane with SPECULATION: ``chunk`` draft/verify steps in
     one scan. Each step, per slot: draft ``spec_k`` tokens by n-gram
@@ -305,11 +313,11 @@ def _spec_decode_chunk_program(params, gcfg, chunk, spec_k, spec_ngram,
     def step(pool, _):
         was_active = pool["active"]
         old_pos = pool["pos"]
-        draft = generation.ngram_draft(pool["toks"], old_pos, spec_ngram,
-                                       spec_k)
+        draft = adapter.ngram_draft(pool["toks"], old_pos, spec_ngram,
+                                    spec_k)
         ids = jnp.concatenate([pool["last_tok"][:, None], draft], axis=1)
-        logits, cache = generation.verify_forward(params, gcfg, ids,
-                                                  cache_view(pool))
+        logits, cache = adapter.verify_forward(params, ids,
+                                               cache_view(pool))
         R = ids.shape[0]
         # choices[:, i] = the model's pick for position old_pos+1+i,
         # conditioned on the draft prefix (== the true prefix wherever
@@ -321,8 +329,8 @@ def _spec_decode_chunk_program(params, gcfg, chunk, spec_k, spec_ngram,
             jnp.repeat(pool["temp"], kp1), jnp.repeat(pool["top_k"], kp1),
             jnp.repeat(pool["seed"], kp1),
             position.reshape(-1)).reshape(R, kp1)
-        n_acc = generation.accept_counts(draft, choices,
-                                         ok=pool["spec"][:, None])
+        n_acc = adapter.accept_counts(draft, choices,
+                                      ok=pool["spec"][:, None])
         # Budget clamp first (the max() keeps frozen rows' gather index
         # valid), then EOS truncation WITHIN the accepted prefix — the
         # same emit-EOS-then-stop order as the 1-token path.
@@ -354,7 +362,7 @@ def _spec_decode_chunk_program(params, gcfg, chunk, spec_k, spec_ngram,
 
 
 @hot_path
-def _mixed_step_program(params, gcfg, chunk, spec, pool, p_ids, p_slot,
+def _mixed_step_program(params, adapter, chunk, spec, pool, p_ids, p_slot,
                         p_frontier, p_valid, p_done, p_spec, p_max_new,
                         p_eos, p_temp, p_top_k, p_seed):
     """One fused serving step — THE chunked-prefill program.
@@ -394,8 +402,8 @@ def _mixed_step_program(params, gcfg, chunk, spec, pool, p_ids, p_slot,
         # an attached request's first chunk starts AT pbase, attending
         # the shared plane below it.
         cache = slot_cache_view(pool, p_slot, p_frontier[None])
-        logits, cache = generation.append_forward(
-            params, gcfg, p_ids, cache, n_valid=p_valid[None])
+        logits, cache = adapter.prefill_append(
+            params, p_ids, cache, n_valid=p_valid[None])
         # The prompt's true last row (garbage pad rows sit past it).
         last = jax.lax.dynamic_index_in_dim(
             logits[0], jnp.clip(p_valid - 1, 0, C - 1), keepdims=False)
@@ -430,10 +438,11 @@ def _mixed_step_program(params, gcfg, chunk, spec, pool, p_ids, p_slot,
     pool, first = jax.lax.cond(
         p_valid > 0, _lane, lambda pool: (pool, jnp.int32(-1)), pool)
     if spec is None:
-        pool, toks, valid = _decode_chunk_program(params, gcfg, chunk, pool)
+        pool, toks, valid = _decode_chunk_program(params, adapter, chunk,
+                                                  pool)
     else:
         pool, toks, valid = _spec_decode_chunk_program(
-            params, gcfg, chunk, spec[0], spec[1], pool)
+            params, adapter, chunk, spec[0], spec[1], pool)
     return pool, first, toks, valid
 
 
@@ -468,19 +477,28 @@ class InferenceEngine(object):
         "_handoff_outbox", "_handoff_enabled",
     })
 
-    def __init__(self, model, params, config=None, mesh=None):
+    def __init__(self, model, params, config=None, mesh=None, adapter=None):
         if config is None:
             config = InferenceConfig()
         elif isinstance(config, dict):
             config = InferenceConfig.from_dict(config)
         self.config = config
-        # The engine's flag wins over the model config's; None defers down
-        # the chain (model config, then on-TPU default). The resolved flag
-        # rides the gencfg static arg, so flash vs einsum is baked into
-        # both programs at trace time — no per-call dispatch.
-        self._gcfg = generation.as_gencfg(
-            getattr(model, "config", model),
-            use_flash_decode=config.use_flash_decode)
+        # The engine<->model boundary is the ModelAdapter protocol
+        # (inference/adapters): None builds the GPT-2 adapter over the
+        # model's config — the engine's use_flash_decode wins over the
+        # model config's, None defers down the chain (model config, then
+        # on-TPU default). ``bind`` lets any adapter specialize to this
+        # engine's config and mesh (sparse/ring mode, expert parallelism).
+        # The adapter IS the static arg of every jitted program, so the
+        # model dispatch is baked at trace time — no per-call branching,
+        # and the compile-count contract is per (engine, adapter).
+        if adapter is None:
+            adapter = GPT2Adapter.from_model(
+                model, use_flash_decode=config.use_flash_decode)
+        self._adapter = adapter.bind(config, mesh)
+        # The adapter's cache spec drives every shape downstream: pool
+        # planes, hierarchy sizing, mesh sharding, admission validation.
+        self._gcfg = self._adapter.cache_spec()
         config.validate_against_model(self._gcfg.n_positions)
         self.mesh = mesh
 
@@ -535,7 +553,12 @@ class InferenceEngine(object):
         self._tp = mesh is not None and mesh_lib.mp_size(mesh) > 1
         pool = self._build_pool()
         if self._tp:
-            param_sh, _, _ = mesh_lib.zero_shardings(mesh, params, stage=0)
+            # Adapter hook first (e.g. MoE's expert-parallel A/B picks
+            # its own TP rules); None falls back to the standard rules.
+            param_sh = self._adapter.param_shardings(mesh, params)
+            if param_sh is None:
+                param_sh, _, _ = mesh_lib.zero_shardings(mesh, params,
+                                                         stage=0)
             params = jax.tree_util.tree_map(jax.device_put, params, param_sh)
             pool_out = pool_shardings(mesh, pool, self._gcfg.n_head)
             rep = mesh_lib.replicated(mesh)
@@ -716,6 +739,12 @@ class InferenceEngine(object):
         pool = init_pool(self._gcfg, self.config.max_slots,
                          self.config.max_len, slack=self._slack,
                          hier=self._hier.spec if self._hier else None)
+        aux = self._adapter.aux_state()
+        if aux:
+            # Adapter-owned pool state (``aux_`` keys): threaded through
+            # every program, fetched by harvest_snapshot, SKIPPED by the
+            # hierarchy's per-slot capture (it is not slot-shaped).
+            pool = dict(pool, **aux)
         if self._tp:
             pool = shard_pool(self.mesh, pool, self._gcfg.n_head)
         return pool
@@ -994,7 +1023,7 @@ class InferenceEngine(object):
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :req.prompt.size] = req.prompt
         self._pool, first = self._prefill(
-            self._params, self._gcfg, self._pool, jnp.asarray(padded),
+            self._params, self._adapter, self._pool, jnp.asarray(padded),
             jnp.int32(req.prompt.size), jnp.int32(slot),
             jnp.int32(req.max_new_tokens), jnp.int32(req.eos_token_id),
             jnp.float32(req.temperature), jnp.int32(req.top_k),
@@ -1401,7 +1430,8 @@ class InferenceEngine(object):
         with self.tracer.timed("step/mixed", prefill_tokens=n_valid), \
                 self._annotate("inference/mixed_step"):
             self._pool, first, toks, valid = self._mixed(
-                self._params, self._gcfg, self.config.chunk_size, self._spec,
+                self._params, self._adapter, self.config.chunk_size,
+                self._spec,
                 self._pool, jnp.asarray(ids), jnp.int32(slot),
                 jnp.int32(frontier), jnp.int32(n_valid), jnp.asarray(p_done),
                 jnp.asarray(p_spec), jnp.int32(max_new), jnp.int32(eos),
@@ -1416,6 +1446,8 @@ class InferenceEngine(object):
             snap = harvest_snapshot(self._pool)
         self._last_snap = snap
         active = snap["active"]
+        # Adapter gauges off the same host snapshot — no extra sync.
+        self._adapter.observe(snap, self.telemetry)
         self.timers("inference/decode").stop()
         if self._injector is not None:
             toks = self._injector.corrupt_harvest(toks, valid)
@@ -1515,7 +1547,7 @@ class InferenceEngine(object):
             with self.tracer.timed("step/decode"), \
                     self._annotate("inference/decode_chunk"):
                 self._pool, toks, valid = self._decode(
-                    self._params, self._gcfg, self.config.chunk_size,
+                    self._params, self._adapter, self.config.chunk_size,
                     self._pool)
             self.timers("inference/decode").stop()
             with self.tracer.timed("step/harvest"), \
@@ -1525,6 +1557,7 @@ class InferenceEngine(object):
                 snap = harvest_snapshot(self._pool)
             self._last_snap = snap
             active = snap["active"]
+            self._adapter.observe(snap, self.telemetry)
             if self._injector is not None:
                 toks = self._injector.corrupt_harvest(toks, valid)
             self._check_harvest(toks, valid)
@@ -1622,6 +1655,11 @@ class InferenceEngine(object):
     # ------------------------------------------------------------ metrics
 
     @property
+    def adapter(self):
+        """The bound ModelAdapter serving this engine (read-only)."""
+        return self._adapter
+
+    @property
     def compile_count(self):
         """Total compiled program count across every engine program — the
         number the zero-recompile-after-warmup guarantee is asserted on.
@@ -1687,6 +1725,7 @@ class InferenceEngine(object):
                 "inference/prefill").elapsed(reset=reset),
             "decode_seconds": self.timers(
                 "inference/decode").elapsed(reset=reset),
+            "adapter": self._adapter.name,
             "flash_decode": bool(self._gcfg.use_flash_decode),
             "chunked_prefill": bool(self.config.chunked_prefill),
             "prefill_chunk": self.config.prefill_chunk,

@@ -412,7 +412,7 @@ class ServingFleet(object):
                  breaker_factory=None, idle_wait_s=0.01, poll_s=0.002,
                  prefix_affinity=None, roles=None,
                  latency_classes=("interactive",), alert_rules=None,
-                 dump_dir=None):
+                 dump_dir=None, adapter=None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1, got "
                              "{}".format(n_replicas))
@@ -452,7 +452,10 @@ class ServingFleet(object):
                 # the engine's pool/programs follow via default_device.
                 p = jax.device_put(params, devices[i])
                 with jax.default_device(devices[i]):
-                    eng = InferenceEngine(model, p, config=cfg)
+                    # Same adapter instance per replica: equal static
+                    # args, so replicas share one compiled program.
+                    eng = InferenceEngine(model, p, config=cfg,
+                                          adapter=adapter)
                 # Commit the fresh pool to its device. default_device
                 # only PLACES it there (uncommitted); the first step's
                 # output pool comes back committed, and a commitment
@@ -462,9 +465,14 @@ class ServingFleet(object):
             else:
                 # Single-device host (CPU tests): replicas share the
                 # device AND the host params — no copies.
-                eng = InferenceEngine(model, params, config=cfg)
+                eng = InferenceEngine(model, params, config=cfg,
+                                      adapter=adapter)
             self.replicas.append(
                 _Replica(i, eng, devices[i], breaker_factory()))
+        # The resolved adapter (every replica shares one instance —
+        # engines fall back to GPT2Adapter when none was passed, so read
+        # it back rather than echoing the argument).
+        self.adapter = self.replicas[0].engine.adapter
         self.router = Router(seed=seed)
         # Fleet-global prefix directory: on by default whenever the
         # replicas run a prefix cache (there is nothing to publish

@@ -50,6 +50,8 @@ def capture_slot(pool, slot):
     for name, arr in pool.items():
         if name in ("pk", "pv", "pk_scale", "pv_scale"):
             continue  # shared prefix planes stay resident
+        if name.startswith("aux_"):
+            continue  # adapter aux state is global, not per-slot
         if name in _PLANE_KEYS:
             arrs[name] = arr[:, slot]
         else:
@@ -73,6 +75,8 @@ def capture_slots(pool, slots):
     for name, arr in pool.items():
         if name in _PREFIX_PLANE_KEYS:
             continue  # shared prefix planes stay resident
+        if name.startswith("aux_"):
+            continue  # adapter aux state is global, not per-slot
         if name in _PLANE_KEYS:
             arrs[name] = arr[:, idx]
         else:

@@ -179,13 +179,15 @@ def harvest_snapshot(pool):
     ``last_tok`` land together, and ``free_slots`` /
     ``max_active_frontier`` derive from the snapshot instead of each
     paying its own sync (three round-trips per chunk collapse to one).
+    Adapter ``aux_`` state (global accumulators, not per-slot) rides the
+    same transfer so ``ModelAdapter.observe`` never pays its own sync.
     The snapshot is a plain dict of numpy arrays — valid until the next
     program call moves the pool."""
     import numpy as np
-    pos, active, last = jax.device_get(
-        (pool["pos"], pool["active"], pool["last_tok"]))
-    return {"pos": np.asarray(pos), "active": np.asarray(active),
-            "last_tok": np.asarray(last)}
+    names = ["pos", "active", "last_tok"]
+    names += [n for n in pool if n.startswith("aux_")]
+    vals = jax.device_get([pool[n] for n in names])
+    return {n: np.asarray(v) for n, v in zip(names, vals)}
 
 
 def max_active_frontier(pool, snap=None):
@@ -234,6 +236,11 @@ def cache_view(pool):
         if "pk_scale" in pool:
             cache["pk_scale"] = jnp.take(pool["pk_scale"], row, axis=1)
             cache["pv_scale"] = jnp.take(pool["pv_scale"], row, axis=1)
+    for name in pool:
+        # Adapter aux state (GLOBAL accumulators, no slot axis) passes
+        # through whole — the forward reads and re-emits it.
+        if name.startswith("aux_"):
+            cache[name] = pool[name]
     return cache
 
 
@@ -263,6 +270,11 @@ def slot_cache_view(pool, slot, pos):
                 pool["pk_scale"], row, 1, axis=1)
             cache["pv_scale"] = jax.lax.dynamic_slice_in_dim(
                 pool["pv_scale"], row, 1, axis=1)
+    for name in pool:
+        # Aux accumulators are global — the batch-1 view carries them
+        # whole, same as cache_view.
+        if name.startswith("aux_"):
+            cache[name] = pool[name]
     return cache
 
 
@@ -277,6 +289,10 @@ def write_slot_cache(pool, slot, cache):
         if name in pool:
             pool[name] = jax.lax.dynamic_update_slice_in_dim(
                 pool[name], cache[name], slot, axis=1)
+    for name in cache:
+        # Global aux accumulators fold back whole (no slot indexing).
+        if name.startswith("aux_"):
+            pool[name] = cache[name]
     return pool
 
 
@@ -289,6 +305,9 @@ def fold_cache(pool, cache):
     if "k_scale" in pool:
         upd["k_scale"] = cache["k_scale"]
         upd["v_scale"] = cache["v_scale"]
+    for name in cache:
+        if name.startswith("aux_"):
+            upd[name] = cache[name]
     return dict(pool, **upd)
 
 

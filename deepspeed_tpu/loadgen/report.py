@@ -24,14 +24,16 @@ import math
 
 from deepspeed_tpu.loadgen import slo as slo_mod
 
-SCHEMA_VERSION = 5  # v2: + chaos section (recovery/requests_lost) and
+SCHEMA_VERSION = 6  # v2: + chaos section (recovery/requests_lost) and
 # per-sample terminal phase. v3: + prefix section (hit rate, bytes
 # shipped by cross-replica adoption, affinity-routed count). v4: +
 # disagg section (prefill->decode handoff counts, fallbacks, bytes
 # shipped). v5: + frontdoor section (per-class SLO attainment, sheds by
 # reason, per-tenant tallies, preemption counts) and per-sample
-# priority/tenant/shed_reason keys — each additive, but comparisons
-# across versions deserve the gate's schema caveat.
+# priority/tenant/shed_reason keys. v6: + adapter section (which
+# ModelAdapter served the run, MoE expert-load balance, the sparse-
+# attention token fraction, offloaded-page counts) — each additive, but
+# comparisons across versions deserve the gate's schema caveat.
 
 # Gate polarity: which direction is a REGRESSION for each report
 # metric. Lower-is-better latencies only fail when they grow;
@@ -223,6 +225,43 @@ def _frontdoor_section(result, slo, class_slos=None):
     }
 
 
+def _adapter_section(result):
+    """Adapter facts for the run (stable schema — a plain GPT-2 run
+    shows the adapter name with empty/zero workload tallies). MoE:
+    per-expert dispatch totals plus the imbalance ratio (max load over
+    uniform share; 1.0 = perfectly balanced) the expert-parallel A/B
+    reads. Long-context: the sparse threshold in force and the fraction
+    of GENERATED tokens emitted from query positions past it — computed
+    from the per-sample geometry, so it is exact for the stream the run
+    actually served — plus the KV host-offload swap deltas
+    (offloaded/restored page counts) that evidence capacity headroom
+    came from the hierarchy, not luck."""
+    load = [float(v) for v in getattr(result, "expert_load", []) or []]
+    total = sum(load)
+    thr = int(getattr(result, "sparse_decode_threshold", 0) or 0)
+    gen = sparse = 0
+    if thr > 0:
+        for s in result.samples:
+            n = s["tokens_out"]
+            if not n:
+                continue
+            gen += n
+            # Generated tokens sit at positions prompt..prompt+n-1; a
+            # token is sparse-served when its position >= threshold.
+            sparse += max(0, s["prompt_tokens"] + n - max(
+                thr, s["prompt_tokens"]))
+    return {
+        "adapter": getattr(result, "adapter", None),
+        "expert_load": load,
+        "expert_load_imbalance": (
+            max(load) * len(load) / total) if total else None,
+        "sparse_decode_threshold": thr,
+        "sparse_token_fraction": (sparse / gen) if gen else None,
+        "offloaded_pages": int(getattr(result, "swap_outs", 0)),
+        "restored_pages": int(getattr(result, "swap_ins", 0)),
+    }
+
+
 def build_report(spec, result, slo, chips=1, platform=None, extra=None,
                  class_slos=None):
     """Fold one RunResult into the report document.
@@ -271,6 +310,7 @@ def build_report(spec, result, slo, chips=1, platform=None, extra=None,
         "prefix": _prefix_section(result),
         "disagg": _disagg_section(result),
         "frontdoor": _frontdoor_section(result, slo, class_slos),
+        "adapter": _adapter_section(result),
         "timeseries": {
             "window_seconds": result.collector.window_seconds,
             "windows_total": result.collector._idx,

@@ -82,6 +82,16 @@ class RunResult:
     # rising-edge record the manager saw during this run, in firing
     # order — telemetry/alerts.py's ``fired()`` schema.
     alerts_fired: list = dataclasses.field(default_factory=list)
+    # Adapter facts (schema v6, zero/empty on plain GPT-2): which
+    # ModelAdapter served the run, per-expert dispatch totals summed
+    # across replicas (MoE), the long-context sparse threshold in force
+    # (0 = dense), and KV host-offload swap counter deltas — the
+    # offloaded-page evidence for long-context capacity runs.
+    adapter: str = None
+    expert_load: list = dataclasses.field(default_factory=list)
+    sparse_decode_threshold: int = 0
+    swap_outs: int = 0
+    swap_ins: int = 0
 
 
 def _sample_row(lr, req, shed_reason=None):
@@ -189,7 +199,8 @@ class SustainedRunner(object):
         prefix_at_start = {n: _counter(n) for n in (
             "prefix_hits", "prefix_misses", "prefix_bytes_shipped",
             "affinity_routed", "handoffs", "handoff_fallbacks",
-            "handoff_bytes_shipped", "preemptions", "preempt_resumes")}
+            "handoff_bytes_shipped", "preemptions", "preempt_resumes",
+            "swap_outs", "swap_ins")}
         while i < len(pending) or not self.engine.idle:
             now = self._clock() - t0
             if (self.chaos_plan is not None and injector is None
@@ -262,6 +273,22 @@ class SustainedRunner(object):
         lost = sum(1 for _, r, _ in handles
                    if r is not None and r.phase not in
                    ("done", "expired", "cancelled"))
+        # Adapter facts: name + sparse threshold off the (shared)
+        # adapter instance; per-expert dispatch gauges summed across
+        # replicas out of the registry snapshot (keys look like
+        # ``moe_expert_load{expert=2,replica=0}`` on a fleet).
+        adapter_obj = getattr(self.engine, "adapter", None)
+        expert_load = {}
+        reg = getattr(self.engine, "telemetry", None)
+        if adapter_obj is not None and reg is not None:
+            for key, val in reg.snapshot().items():
+                if not key.startswith("moe_expert_load{"):
+                    continue
+                for part in key[key.index("{") + 1:-1].split(","):
+                    k, _, v = part.partition("=")
+                    if k == "expert":
+                        e = int(v)
+                        expert_load[e] = expert_load.get(e, 0.0) + val
         return RunResult(
             samples=samples,
             windows=self.collector.windows(),
@@ -295,4 +322,13 @@ class SustainedRunner(object):
             preempt_resumes=_counter("preempt_resumes")
             - prefix_at_start["preempt_resumes"],
             alerts_fired=([] if self.alerts is None
-                          else self.alerts.fired()))
+                          else self.alerts.fired()),
+            adapter=(None if adapter_obj is None
+                     else getattr(adapter_obj, "name", None)),
+            expert_load=[expert_load[e] for e in sorted(expert_load)],
+            sparse_decode_threshold=int(
+                getattr(adapter_obj, "threshold", 0) or 0),
+            swap_outs=_counter("swap_outs")
+            - prefix_at_start["swap_outs"],
+            swap_ins=_counter("swap_ins")
+            - prefix_at_start["swap_ins"])

@@ -37,7 +37,16 @@ from deepspeed_tpu.ops.transformer.kernels import decode_attention
 _GenCfg = collections.namedtuple(
     "_GenCfg",
     "n_layer n_head n_embd n_positions dtype layer_norm_epsilon "
-    "use_flash_decode", defaults=(False,))
+    "use_flash_decode sparse_block sparse_num_local sparse_num_global "
+    "sparse_threshold", defaults=(False, 0, 0, 0, 0))
+# The sparse_* tail (defaults keep every existing construction dense and
+# bit-identical): when sparse_threshold > 0, einsum-path attention for
+# query positions >= the threshold is restricted to the block-sparse
+# local+stride layout (FixedSparsityConfig, unidirectional) with block
+# side sparse_block, sparse_num_local local blocks per window and
+# sparse_num_global global blocks. Positions below the threshold keep the
+# full causal mask — the long-context adapter's "dense below, sparse
+# above" contract (inference/adapters/longcontext.py).
 
 
 def default_flash_decode():
@@ -69,6 +78,21 @@ def as_gencfg(cfg, use_flash_decode=None):
     return _GenCfg(cfg.n_layer, cfg.n_head, cfg.n_embd, cfg.n_positions,
                    cfg.dtype, getattr(cfg, "layer_norm_epsilon", 1e-5),
                    bool(flag))
+
+
+@functools.lru_cache(maxsize=None)
+def _sparse_layout(block, num_local, num_global, num_blocks):
+    """Trace-time [num_blocks, num_blocks] bool block-visibility table for
+    the fixed (local+stride) unidirectional pattern. Pure numpy metadata —
+    cached per geometry, shipped to the device once as a constant."""
+    import numpy as np
+    from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+        FixedSparsityConfig)
+    layout = FixedSparsityConfig(
+        num_heads=1, block=block, num_local_blocks=num_local,
+        num_global_blocks=num_global,
+        attention="unidirectional").make_layout(num_blocks * block)
+    return np.asarray(layout[0], dtype=bool)
 
 
 def init_cache(cfg, batch, max_len, dtype=None):
@@ -137,6 +161,11 @@ def _forward(params, cfg, ids, cache, last_only=False):
     # caches of other lengths take the einsum path below — same math).
     use_flash = cfg.use_flash_decode and \
         decode_attention.decode_supported(max_len)
+    sparse_thr = getattr(cfg, "sparse_threshold", 0)
+    if sparse_thr and use_flash:
+        raise ValueError(
+            "block-sparse decode (sparse_threshold > 0) requires the einsum "
+            "attention path; construct the config with use_flash_decode=False")
     if not use_flash:
         k_pos = jnp.arange(max_len)                    # [max_len]
         # Causal vs each row's GLOBAL position: key j visible to query i
@@ -144,6 +173,19 @@ def _forward(params, cfg, ids, cache, last_only=False):
         # the same comparison (they hold zeros — or a stale request's
         # k/v, which decode overwrites before the frontier reaches them).
         mask = k_pos[None, None, :] <= q_pos[:, :, None]  # [B, S, max_len]
+        if sparse_thr:
+            # Long-context composition: rows whose query position crossed
+            # the threshold see only the block-sparse layout; below it the
+            # extra term is all-True, leaving the causal mask bit-identical
+            # to the dense path (the parity half of the adapter contract).
+            blk = cfg.sparse_block
+            nb = -(-max_len // blk)
+            layout = jnp.asarray(_sparse_layout(
+                blk, cfg.sparse_num_local, cfg.sparse_num_global, nb))
+            q_blk = jnp.minimum(q_pos // blk, nb - 1)    # [B, S]
+            visible = layout[q_blk[:, :, None],
+                             (k_pos // blk)[None, None, :]]
+            mask = mask & ((q_pos < sparse_thr)[:, :, None] | visible)
         neg = jnp.finfo(jnp.float32).min
     k_cache, v_cache = cache["k"], cache["v"]
     if int8:

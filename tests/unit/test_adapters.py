@@ -1,0 +1,488 @@
+"""Adapter conformance kit (inference/adapters/ — docs/ADAPTERS.md).
+
+Every ModelAdapter implementation must pass the same battery, because
+the engine is model-blind and trusts exactly these properties:
+
+1. CHUNK-VS-WHOLE PREFILL PARITY — consuming a prompt in chunks lands
+   the same cache frontier and the same greedy continuation as one
+   whole-prompt append (chunked prefill rides on it).
+2. DEEP-FRONTIER APPEND + n_valid — an append at a deep frontier with a
+   partial-valid override advances ``pos`` by n_valid only, and the
+   stale positions it wrote past the frontier are invisible once
+   overwritten (the stale-cache rule).
+3. VERIFY/ACCEPT ROLLBACK INVISIBILITY — a rejected speculative verify
+   leaves no trace: ``pos`` comes back unchanged and the continuation
+   is bit-identical to a never-speculated stream.
+4. ONE COMPILED PROGRAM — a mixed greedy/sampled/spec workload through
+   the engine compiles exactly one mixed-step program per adapter.
+5. CAPTURE/RESTORE ROUND-TRIP — a slot captured from the pool restores
+   bit-identically into any other slot, and adapter ``aux_`` state
+   (global, not per-slot) is excluded from the record but preserved in
+   the pool.
+
+Plus the adapter-specific pins: MoE expert gauges + expert-parallel
+serving on a 2-axis mesh, and the long-context parity/capacity pair.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import InferenceConfig, InferenceEngine
+from deepspeed_tpu.inference.adapters import (
+    GPT2Adapter,
+    LongContextAdapter,
+    ModelAdapter,
+    MoEAdapter,
+)
+from deepspeed_tpu.inference.kv_hierarchy import offload
+from deepspeed_tpu.inference.kv_pool import harvest_snapshot
+from deepspeed_tpu.parallel import mesh as mesh_lib
+from tests.unit.test_inference import make_model, prompts_of, seq_greedy
+
+KINDS = ("gpt2", "moe", "longcontext")
+
+_ADAPTERS = {}
+
+
+def adapter_of(kind):
+    """(adapter, params, vocab_size) per kind — memoized, params are
+    read-only everywhere downstream. The longcontext conformance
+    instance keeps its threshold ABOVE every sequence the kit builds,
+    so the battery exercises the adapter plumbing while its masks stay
+    dense (the sparse regime has its own pins below)."""
+    if kind not in _ADAPTERS:
+        if kind == "moe":
+            a = MoEAdapter.from_config(vocab_size=256, n_layer=2, n_head=2,
+                                       n_embd=32, n_positions=128,
+                                       n_experts=4)
+            params = a.init_params(jax.random.PRNGKey(0))
+            _ADAPTERS[kind] = (a, params, 256)
+        else:
+            cfg, model, params = make_model()
+            if kind == "gpt2":
+                a = GPT2Adapter.from_model(model, use_flash_decode=False)
+            else:
+                a = LongContextAdapter.from_model(
+                    model, threshold=96, block=8, num_local_blocks=2)
+            _ADAPTERS[kind] = (a, params, cfg.vocab_size)
+    return _ADAPTERS[kind]
+
+
+def ids_of(vocab, n, seed=5):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, vocab, size=(1, n)).astype(np.int32)
+
+
+def greedy_decode(adapter, params, tok, cache, steps):
+    out = []
+    for _ in range(steps):
+        logits, cache = adapter.decode_step(
+            params, jnp.asarray([tok], jnp.int32), cache)
+        tok = int(jnp.argmax(logits[0]))
+        out.append(tok)
+    return out, cache
+
+
+_PRIM_REFS = {}
+
+
+def primitive_greedy(kind, prompt, max_new, plane_len=96):
+    """Sequential single-request greedy reference built from the
+    adapter's OWN primitives — the oracle the slotted engine must match
+    (per-row independence makes batch composition irrelevant)."""
+    key = (kind, tuple(int(t) for t in prompt), int(max_new))
+    if key not in _PRIM_REFS:
+        adapter, params, _ = adapter_of(kind)
+        cache = adapter.init_cache(1, plane_len)
+        ids = jnp.asarray(np.asarray(prompt)[None].astype(np.int32))
+        logits, cache = adapter.prefill_append(params, ids, cache)
+        tok = int(jnp.argmax(logits[0, -1]))
+        toks = [tok]
+        more, _ = greedy_decode(adapter, params, tok, cache, max_new - 1)
+        _PRIM_REFS[key] = toks + more
+    return _PRIM_REFS[key]
+
+
+def engine_of_kind(kind, **kw):
+    adapter, params, vocab = adapter_of(kind)
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("chunk_size", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("use_flash_decode", False)
+    return InferenceEngine(None, params, config=kw, adapter=adapter)
+
+
+# ----------------------------------------------------- protocol surface
+
+
+def test_protocol_required_surface_raises_unimplemented():
+    base = ModelAdapter()
+    with pytest.raises(NotImplementedError):
+        base.cache_spec()
+    with pytest.raises(NotImplementedError):
+        base.init_cache(1, 8)
+    # Optional hooks have working defaults.
+    assert base.bind(None) is base
+    assert base.aux_state() == {}
+    assert base.param_shardings(None, None) is None
+    assert base.observe(None, None) is None
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_adapter_is_hashable_static_arg(kind):
+    adapter, _, _ = adapter_of(kind)
+    assert hash(adapter) == hash(adapter)
+    assert adapter == type(adapter)(**{
+        f.name: getattr(adapter, f.name)
+        for f in __import__("dataclasses").fields(adapter)})
+
+
+# ------------------------------------------------- 1. chunk-vs-whole
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_chunk_vs_whole_prefill_parity(kind):
+    adapter, params, vocab = adapter_of(kind)
+    ids = jnp.asarray(ids_of(vocab, 12))
+
+    whole = adapter.init_cache(1, 32)
+    logits_w, whole = adapter.prefill_append(params, ids, whole)
+
+    chunked = adapter.init_cache(1, 32)
+    for lo in (0, 4, 8):
+        logits_c, chunked = adapter.prefill_append(
+            params, ids[:, lo:lo + 4], chunked)
+
+    assert int(whole["pos"][0]) == int(chunked["pos"][0]) == 12
+    np.testing.assert_allclose(np.asarray(logits_w[:, -1]),
+                               np.asarray(logits_c[:, -1]),
+                               rtol=2e-5, atol=2e-5)
+    tok_w = int(jnp.argmax(logits_w[0, -1]))
+    tok_c = int(jnp.argmax(logits_c[0, -1]))
+    assert tok_w == tok_c
+    cont_w, _ = greedy_decode(adapter, params, tok_w, whole, 5)
+    cont_c, _ = greedy_decode(adapter, params, tok_c, chunked, 5)
+    assert cont_w == cont_c, "chunked prefill diverged from whole-prompt"
+
+
+# --------------------------------------- 2. deep frontier + stale rule
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_append_at_deep_frontier_with_n_valid(kind):
+    adapter, params, vocab = adapter_of(kind)
+    ids = jnp.asarray(ids_of(vocab, 28, seed=7))
+
+    clean = adapter.init_cache(1, 48)
+    logits, clean = adapter.prefill_append(params, ids, clean)
+    want, _ = greedy_decode(adapter, params,
+                            int(jnp.argmax(logits[0, -1])), clean, 4)
+
+    # Staged: 24 tokens, then a 4-token append of which only 2 are the
+    # true continuation (n_valid=2) — positions 26/27 get k/v for
+    # GARBAGE tokens past the frontier.
+    garbage = jnp.asarray(ids_of(vocab, 2, seed=99))
+    staged = adapter.init_cache(1, 48)
+    _, staged = adapter.prefill_append(params, ids[:, :24], staged)
+    tail = jnp.concatenate([ids[:, 24:26], garbage], axis=1)
+    _, staged = adapter.prefill_append(params, tail, staged,
+                                       n_valid=jnp.asarray([2]))
+    assert int(staged["pos"][0]) == 26, "n_valid must override the advance"
+    # The true continuation overwrites the stale positions before any
+    # query can attend them — the garbage must be invisible.
+    logits, staged = adapter.prefill_append(params, ids[:, 26:28], staged)
+    got, _ = greedy_decode(adapter, params,
+                           int(jnp.argmax(logits[0, -1])), staged, 4)
+    assert got == want, "stale frontier write leaked into the stream"
+
+
+# ------------------------------------- 3. verify rollback invisibility
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_verify_rollback_is_invisible(kind):
+    adapter, params, vocab = adapter_of(kind)
+    ids = jnp.asarray(ids_of(vocab, 10, seed=3))
+
+    def stream(speculate):
+        cache = adapter.init_cache(1, 32)
+        logits, cache = adapter.prefill_append(params, ids, cache)
+        tok = int(jnp.argmax(logits[0, -1]))
+        toks = [tok]
+        head, cache = greedy_decode(adapter, params, tok, cache, 2)
+        toks += head
+        if speculate:
+            # A verify whose whole draft gets rejected: k/v written at
+            # the frontier are stale garbage, pos must come back
+            # unchanged (the adapter's rollback contract).
+            pos0 = int(cache["pos"][0])
+            draft = jnp.asarray(
+                [[toks[-1]] + ids_of(vocab, 2, seed=42)[0].tolist()],
+                jnp.int32)
+            vlogits, cache = adapter.verify_forward(params, draft, cache)
+            assert vlogits.shape[1] == 3
+            assert int(cache["pos"][0]) == pos0, \
+                "verify_forward must not advance the frontier"
+        tail, cache = greedy_decode(adapter, params, toks[-1], cache, 4)
+        return toks + tail
+
+    assert stream(True) == stream(False), \
+        "a rejected speculation changed the stream"
+
+
+# ----------------------------------- 4. engine: one program, parity
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_engine_mixed_workload_single_compile_and_parity(kind):
+    """Mixed greedy/sampled, spec-on/spec-off requests trickling through
+    the slotted engine: ONE compiled program, greedy streams match the
+    adapter-primitive oracle, sampled streams reproduce on resubmit."""
+    adapter, params, vocab = adapter_of(kind)
+    eng = engine_of_kind(kind, spec_decode=True, spec_k=2, spec_ngram=2)
+    assert eng.metrics()["adapter"] == adapter.name
+
+    rng = np.random.RandomState(17)
+    lens = [5, 9, 6, 12, 7, 8]
+    prompts = [rng.randint(0, vocab, size=(n,)).astype(np.int32)
+               for n in lens]
+    reqs = []
+    for i, p in enumerate(prompts):
+        kw = {"max_new_tokens": 5 + (i % 3)}
+        if i % 2:
+            kw["temperature"] = 0.7
+            kw["seed"] = 100 + i
+        if i % 3 == 0:
+            kw["spec_decode"] = False
+        reqs.append(eng.submit(p, **kw))
+        eng.step()
+    eng.run()
+    assert eng.compile_count == 1, \
+        "{} adapter broke the one-program contract".format(adapter.name)
+
+    for i, (p, r) in enumerate(zip(prompts, reqs)):
+        assert len(r.tokens) == 5 + (i % 3)
+        if i % 2 == 0:  # greedy rows: exact oracle parity
+            assert r.tokens == primitive_greedy(kind, p, len(r.tokens)), \
+                "slot-served greedy stream diverged from the primitives"
+    # Sampled determinism: resubmitting reproduces the stream (the
+    # positional rng is adapter-independent per-row state).
+    redo = eng.submit(prompts[1], max_new_tokens=6, temperature=0.7,
+                      seed=101)
+    eng.run()
+    assert redo.tokens == reqs[1].tokens
+    assert eng.compile_count == 1
+
+
+# ------------------------------------- 5. capture/restore round-trip
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_capture_restore_round_trip_excludes_aux(kind):
+    adapter, params, vocab = adapter_of(kind)
+    eng = engine_of_kind(kind)
+    for n in (6, 9):
+        eng.submit(ids_of(vocab, n, seed=n)[0], max_new_tokens=8)
+    eng.step()
+    eng.step()
+    pool = eng._pool
+
+    rec = offload.capture_slot(pool, 0)
+    assert not any(k.startswith("aux_") for k in rec), \
+        "global aux state must not be captured per-slot"
+    restored = offload.restore_slot(pool, 1, rec)
+    np.testing.assert_array_equal(np.asarray(restored["k"][:, 1]),
+                                  rec["k"])
+    np.testing.assert_array_equal(np.asarray(restored["v"][:, 1]),
+                                  rec["v"])
+    for name in ("pos", "last_tok", "active", "toks"):
+        np.testing.assert_array_equal(np.asarray(restored[name][1]),
+                                      rec[name])
+    # Batched capture agrees with the per-slot form.
+    batched = offload.capture_slots(pool, [0, 1])
+    for name, val in rec.items():
+        np.testing.assert_array_equal(batched[0][name], val)
+    if kind == "moe":
+        # aux rides the harvest snapshot and survives restore untouched.
+        assert "aux_moe_load" in restored
+        snap = harvest_snapshot(restored)
+        assert snap["aux_moe_load"].shape == (4,)
+        np.testing.assert_array_equal(snap["aux_moe_load"],
+                                      np.asarray(pool["aux_moe_load"]))
+
+
+# ------------------------------------------------------- MoE specifics
+
+
+def test_moe_expert_gauges_and_no_drops():
+    adapter, params, vocab = adapter_of("moe")
+    eng = engine_of_kind("moe")
+    for n in (6, 10, 7):
+        eng.submit(ids_of(vocab, n, seed=n)[0], max_new_tokens=6)
+    eng.run()
+    reg = eng.telemetry
+    load = [reg.gauge("moe_expert_load", expert=str(i)).value
+            for i in range(4)]
+    assert sum(load) > 0, "no expert dispatch was observed"
+    assert reg.gauge("moe_tokens_routed").value > 0
+    # capacity_factor=0 sentinel: capacity == tokens, nothing drops —
+    # the per-row independence the failover invariant rests on.
+    assert reg.gauge("moe_tokens_dropped").value == 0.0
+    assert reg.gauge("moe_drop_rate").value == 0.0
+    assert reg.gauge("moe_capacity_factor").value == 4.0
+    assert reg.gauge("moe_expert_load_imbalance").value >= 1.0
+    assert "moe_expert_load" in eng.prometheus()
+
+
+def test_moe_expert_parallel_two_axis_mesh(eight_devices):
+    """MoE serving over a dp×mp mesh: expert stacks shard over 'model'
+    (the DEFAULT_TP_RULES experts rule), tokens match the unsharded
+    engine exactly, one compiled program."""
+    adapter, params, vocab = adapter_of("moe")
+    mesh = mesh_lib.build_mesh(devices=jax.devices()[:4], num_dp=2,
+                               num_mp=2)
+    prompts = [ids_of(vocab, n, seed=n)[0] for n in (5, 8, 6)]
+
+    base = engine_of_kind("moe")
+    want = [base.submit(p, max_new_tokens=6) for p in prompts]
+    base.run()
+
+    eng = InferenceEngine(None, params,
+                          config={"max_slots": 3, "max_len": 64,
+                                  "chunk_size": 4, "prefill_chunk": 8},
+                          mesh=mesh, adapter=adapter)
+    got = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run()
+    for w, g in zip(want, got):
+        assert g.tokens == w.tokens, "expert-parallel stream diverged"
+    spec = eng._params["h_0"]["experts"]["w1"].sharding.spec
+    assert spec[0] == mesh_lib.MODEL_AXIS
+    assert eng.compile_count == 1
+
+
+def test_moe_no_expert_parallel_flag_replicates_experts(eight_devices):
+    adapter, params, vocab = adapter_of("moe")
+    mesh = mesh_lib.build_mesh(devices=jax.devices()[:4], num_dp=2,
+                               num_mp=2)
+    eng = InferenceEngine(None, params,
+                          config={"max_slots": 2, "max_len": 64,
+                                  "chunk_size": 4, "prefill_chunk": 8,
+                                  "expert_parallel": False},
+                          mesh=mesh, adapter=adapter)
+    assert not eng.adapter.expert_parallel
+    spec = eng._params["h_0"]["experts"]["w1"].sharding.spec
+    assert not spec or spec[0] is None  # replicated, not expert-sharded
+    p = ids_of(vocab, 6)[0]
+    r = eng.submit(p, max_new_tokens=5)
+    eng.run()
+    assert r.tokens == primitive_greedy("moe", p, 5)
+
+
+def test_moe_rejects_hierarchy_tiers():
+    adapter, params, vocab = adapter_of("moe")
+    cache = adapter.init_cache(1, 16)
+    bad = dict(cache, k=cache["k"].astype(jnp.int8),
+               v=cache["v"].astype(jnp.int8))
+    with pytest.raises(ValueError, match="plain fp"):
+        adapter.prefill_append(params, jnp.asarray(ids_of(vocab, 4)), bad)
+
+
+# ----------------------------------------------- long-context specifics
+
+
+def test_longcontext_below_threshold_token_identical_to_dense():
+    """Every query position below the threshold: the sparse mask term is
+    all-true, so streams are BIT-identical to the dense GPT-2 engine."""
+    cfg, model, params = make_model()
+    adapter = LongContextAdapter.from_model(model, threshold=32, block=8,
+                                            num_local_blocks=2)
+    eng = engine_of_kind("gpt2")  # dense reference engine
+    lc = InferenceEngine(None, params,
+                         config={"max_slots": 3, "max_len": 64,
+                                 "chunk_size": 4, "prefill_chunk": 8,
+                                 "use_flash_decode": False},
+                         adapter=adapter)
+    assert lc.metrics()["adapter"] == "longcontext"
+    prompts = prompts_of(cfg, [5, 9, 6])
+    # prompt + new <= 32 for every request: nothing crosses the threshold.
+    want = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.run()
+    got = [lc.submit(p, max_new_tokens=8) for p in prompts]
+    lc.run()
+    for w, g in zip(want, got):
+        assert g.tokens == w.tokens, \
+            "below-threshold long-context decode diverged from dense"
+    assert lc.compile_count == 1
+
+
+def test_longcontext_capacity_pin_sparse_decode_with_host_offload():
+    """The capacity pin: more concurrent long sessions than HBM slots,
+    every stream crossing into the block-sparse regime, host offload
+    parking the overflow — all complete, swaps fired, one program. The
+    below-threshold prefix of each stream still matches dense bit for
+    bit (parity and sparsity in one run)."""
+    cfg, model, params = make_model()
+    adapter = LongContextAdapter.from_model(model, threshold=32, block=8,
+                                            num_local_blocks=2)
+    lc = InferenceEngine(None, params,
+                         config={"max_slots": 2, "max_len": 64,
+                                 "chunk_size": 4, "prefill_chunk": 8,
+                                 "host_offload": True, "swap_slots": 8,
+                                 "use_flash_decode": False},
+                         adapter=adapter)
+    prompts = prompts_of(cfg, [8, 6, 7, 9], seed=21)
+    news = [40, 38, 36, 34]  # prompt + new > threshold for every request
+    reqs = [lc.submit(p, max_new_tokens=n) for p, n in zip(prompts, news)]
+    lc.run()
+    m = lc.metrics()
+    assert all(len(r.tokens) == n for r, n in zip(reqs, news)), \
+        "a long session failed to complete under offload pressure"
+    assert m["swap_outs"] >= 1 and m["swap_ins"] >= 1, \
+        "capacity pin must actually exercise host offload"
+    assert m["compile_count"] == 1 and m["adapter"] == "longcontext"
+    assert lc.telemetry.gauge("sparse_decode_threshold").value == 32.0
+    # Tokens emitted from query positions still under the threshold are
+    # dense-identical; the streams then continue block-sparse.
+    for p, r in zip(prompts, reqs):
+        upto = max(0, 32 - len(p) - 4)  # stay clear of the boundary
+        assert r.tokens[:upto] == seq_greedy(model, params, p, upto), \
+            "below-threshold prefix diverged from dense"
+
+
+def test_longcontext_no_sparse_decode_flag_is_dense():
+    """--no-sparse-decode A/B arm: config.sparse_decode=False drops the
+    threshold at bind time, so even far-past-threshold streams are
+    bit-identical to the dense engine."""
+    cfg, model, params = make_model()
+    adapter = LongContextAdapter.from_model(model, threshold=16, block=8,
+                                            num_local_blocks=2)
+    lc = InferenceEngine(None, params,
+                         config={"max_slots": 2, "max_len": 64,
+                                 "chunk_size": 4, "prefill_chunk": 8,
+                                 "sparse_decode": False,
+                                 "use_flash_decode": False},
+                         adapter=adapter)
+    assert lc.adapter.threshold == 0  # bind stripped the sparse window
+    p = prompts_of(cfg, [7], seed=4)[0]
+    r = lc.submit(p, max_new_tokens=30)
+    lc.run()
+    assert r.tokens == seq_greedy(model, params, p, 30)
+
+
+def test_longcontext_ring_fallback_on_seq_mesh(eight_devices):
+    """A mesh carrying a 'seq' axis flips bind into ring mode: dense
+    attention over a sequence-sharded plane (sparse masking and seq
+    sharding compose poorly — module docstring)."""
+    _, model, _ = make_model()
+    adapter = LongContextAdapter.from_model(model, threshold=32, block=8,
+                                            num_local_blocks=2)
+    mesh = mesh_lib.build_mesh(devices=jax.devices()[:2], num_sp=2,
+                               num_dp=1)
+    bound = adapter.bind(InferenceConfig(), mesh)
+    assert bound.mode == "ring"
+    assert bound.threshold == 0  # dense masks under sequence sharding
+    # No mesh (or no seq axis): block-sparse mode sticks.
+    assert adapter.bind(InferenceConfig(), None).mode == "block_sparse"

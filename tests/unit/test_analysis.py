@@ -175,6 +175,58 @@ def test_thread_checked_class_without_manifest():
     assert _rules_hit(findings) == {"THREADRACE"}
 
 
+# ------------------------------------------------------------ ADAPTER rule
+#
+# Path-sensitive (fires only under deepspeed_tpu/inference/), so it is
+# tested via analyze_source with synthetic paths instead of the fixture
+# corpus — a fixture under tests/ would be out of the rule's scope.
+
+_SERVING_PATH = "/x/deepspeed_tpu/inference/scheduler.py"
+
+
+@pytest.mark.parametrize("src", [
+    "from deepspeed_tpu.models import generation\n",
+    "import deepspeed_tpu.models.generation\n",
+    "from deepspeed_tpu.models.generation import decode_step\n",
+])
+def test_adapter_flags_generation_import_in_inference(src):
+    findings = analyze_source(_SERVING_PATH, src)
+    assert _rules_hit(findings) == {"ADAPTER"}, (src, findings)
+
+
+def test_adapter_sanctions_gpt2_adapter_only():
+    src = "from deepspeed_tpu.models import generation\n"
+    gpt2 = "/x/deepspeed_tpu/inference/adapters/gpt2.py"
+    assert analyze_source(gpt2, src) == []
+    other = "/x/deepspeed_tpu/inference/adapters/moe.py"
+    assert _rules_hit(analyze_source(other, src)) == {"ADAPTER"}
+
+
+def test_adapter_silent_outside_inference():
+    src = "from deepspeed_tpu.models import generation\n"
+    assert analyze_source("/x/deepspeed_tpu/models/gpt2.py", src) == []
+    assert analyze_source("/x/tests/unit/test_inference.py", src) == []
+
+
+def test_adapter_allows_protocol_imports():
+    src = ("from deepspeed_tpu.inference.adapters import GPT2Adapter\n"
+           "from deepspeed_tpu.models import gpt2\n")
+    assert analyze_source(_SERVING_PATH, src) == []
+
+
+def test_adapter_rule_suppressible():
+    src = ("from deepspeed_tpu.models import generation"
+           "  # graftlint: disable=ADAPTER\n")
+    assert analyze_source(_SERVING_PATH, src) == []
+
+
+def test_adapter_rule_registered():
+    from deepspeed_tpu.analysis.core import RULE_NAMES
+    from deepspeed_tpu.analysis.rules import RULES as REGISTRY
+    assert "ADAPTER" in RULE_NAMES
+    assert "ADAPTER" in REGISTRY
+
+
 # ------------------------------------------------------------ annotations
 
 def test_hot_path_is_identity():

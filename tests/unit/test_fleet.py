@@ -647,3 +647,90 @@ def test_fleet_metrics_reset_brackets_like_a_lone_engine():
             len(batch_a) + len(batch_b))
     finally:
         fleet.close()
+
+
+# -------------------------------------------- failover: MoE adapter
+
+
+_MOE = {}
+
+
+def _moe_setup():
+    """Shared MoE adapter + params + mixed prompt set (vocab 256)."""
+    if "a" not in _MOE:
+        import jax
+
+        from deepspeed_tpu.inference.adapters import MoEAdapter
+        a = MoEAdapter.from_config(vocab_size=256, n_layer=2, n_head=2,
+                                   n_embd=32, n_positions=128,
+                                   n_experts=4)
+        params = a.init_params(jax.random.PRNGKey(0))
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(0, 256, size=(n,)).astype(np.int32)
+                   for n in _MIX_LENS]
+        _MOE["a"] = (a, params, prompts)
+    return _MOE["a"]
+
+
+def test_moe_failover_invariant_mid_stream_kill():
+    """The GPT-2 failover invariant, re-pinned for the MoE adapter:
+    kill a replica mid-decode and every replayed stream is BIT-identical
+    to the fault-free single-engine run. This is only true because (a)
+    the positional fold_in(seed, pos) rng is per-row state that expert
+    routing cannot perturb, and (b) the adapter's capacity_factor=0
+    sentinel pins expert capacity == tokens, so no token's output ever
+    depends on which rows share its batch (a dropped-token MoE would
+    replay DIFFERENT tokens after failover — the invariant this test
+    exists to hold)."""
+    from deepspeed_tpu.inference import InferenceEngine
+    adapter, params, prompts = _moe_setup()
+    numerics = {"max_slots": 3, "max_len": 64, "chunk_size": 4,
+                "prefill_chunk": 8, "spec_decode": True, "spec_k": 2,
+                "spec_ngram": 2, "use_flash_decode": False}
+
+    ref_eng = InferenceEngine(None, params, config=dict(numerics),
+                              adapter=adapter)
+    ref_reqs = [ref_eng.submit(p, **_mix_kw(i))
+                for i, p in enumerate(prompts)]
+    ref_eng.run()
+    ref = [list(r.tokens) for r in ref_reqs]
+
+    serve = dict(numerics, fault_injection=True, recovery_max_retries=0,
+                 max_queue=32)
+    fleet = ServingFleet(None, params, n_replicas=2, config=serve,
+                         seed=0, start=False, window_seconds=0.05,
+                         adapter=adapter)
+    try:
+        frs = [fleet.submit(p, **_mix_kw(i))
+               for i, p in enumerate(prompts)]
+        victims = [fr for fr in frs if fr.replica_id == 0]
+        assert victims and len(victims) < len(frs)
+        for _ in range(200):
+            if any(fr.tokens and not fr.done for fr in victims):
+                break
+            fleet.step()
+        else:
+            pytest.fail("replica 0 never reached mid-stream")
+        unfinished_at_kill = {fr.fid for fr in victims if not fr.done}
+        fleet.inject_faults(
+            FaultPlan(faults=(Fault("raise", step=0),)), replica=0)
+        assert fleet.wait_idle(timeout_s=120.0)
+
+        assert all(fr.phase == "done" for fr in frs)       # zero lost
+        assert [fr.tokens for fr in frs] == ref            # bit-identical
+        moved = [fr for fr in frs if fr.failovers > 0]
+        assert {fr.fid for fr in moved} == unfinished_at_kill
+        assert all(fr.replica_id == 1 for fr in moved)
+        m = fleet.metrics()["fleet"]
+        assert m["health"] == "healthy" and m["orphans"] == 0
+        # Per-expert load reaches the fleet's merged scrape.
+        kinds, samples = _parse_prom(fleet.prometheus())
+        assert kinds.get("ds_tpu_moe_expert_load") == "gauge"
+        load = [v for (n, _lbl), v in samples.items()
+                if n == "ds_tpu_moe_expert_load"]
+        assert load and sum(load) > 0
+        drops = [v for (n, _lbl), v in samples.items()
+                 if n == "ds_tpu_moe_tokens_dropped"]
+        assert drops and all(v == 0.0 for v in drops)
+    finally:
+        fleet.close()

@@ -183,6 +183,36 @@ def test_workload_template_heavy_preset():
     assert all(10 <= r.prompt.size <= 20 for r in small)
 
 
+def test_workload_long_context_preset():
+    """The ``long_context`` preset is heavy-tailed by construction: the
+    lognormal body sits in the thousands of tokens and the right tail
+    reaches past 32k (the regime block-sparse decode + host offload
+    serve). Same-seeded calls stay byte-identical; overrides shrink the
+    geometry for tiny engines."""
+    a = WorkloadSpec.long_context(seed=3).requests()
+    b = WorkloadSpec.long_context(seed=3).requests()
+    assert len(a) == 32
+    for x, y in zip(a, b):
+        assert x.arrival_s == y.arrival_s
+        assert np.array_equal(x.prompt, y.prompt)
+    sizes = sorted(r.prompt.size for r in a)
+    assert all(512 <= s <= 65536 for s in sizes)
+    assert sizes[len(sizes) // 2] >= 1024    # body: thousands of tokens
+    # The 32k+ tail is reachable and present across nearby seeds (the
+    # per-seed probability is a few percent; a handful of seeds sees it
+    # without making any single stream pathological).
+    tail = [r.prompt.size
+            for s in range(6) for r in WorkloadSpec.long_context(
+                seed=s).requests() if r.prompt.size > 32768]
+    assert tail, "no 32k+ prompt across seeds 0..5 — tail too thin"
+    assert all(16 <= r.max_new_tokens <= 512 for r in a)
+    small = WorkloadSpec.long_context(
+        seed=3, n_requests=6, prompt_mean=24, prompt_min=8,
+        prompt_max=40, output_min=2, output_max=8).requests()
+    assert len(small) == 6
+    assert all(8 <= r.prompt.size <= 40 for r in small)
+
+
 def test_workload_prefix_pool_trace_roundtrip(tmp_path):
     """Shared-prefix streams replay exactly through the JSONL trace
     path (explicit token ids — the prefix structure survives)."""
@@ -482,7 +512,7 @@ def test_report_prefix_section_counts_hits_and_misses():
     assert res.prefix_hits > 0
     assert res.prefix_hits + res.prefix_misses >= 16
     rep = build_report(spec, res, SLO(ttft_p99_ms=1e4, itl_p99_ms=2e3))
-    assert rep["schema_version"] == 5
+    assert rep["schema_version"] == 6
     sec = rep["prefix"]
     assert sec["prefix_hits"] == res.prefix_hits
     assert sec["prefix_misses"] == res.prefix_misses
@@ -502,6 +532,80 @@ def test_report_prefix_section_counts_hits_and_misses():
     rep2 = build_report(spec, res2, SLO(ttft_p99_ms=1e4, itl_p99_ms=2e3))
     assert rep2["prefix"]["hit_rate"] is None
     plain.close()
+
+
+def test_report_adapter_section_moe_and_longcontext():
+    """The v6 ``adapter`` section: an MoE run carries the adapter name,
+    per-expert dispatch totals and the imbalance ratio; a long-context
+    run carries the sparse threshold plus the EXACT fraction of
+    generated tokens served past it (computed from the per-sample
+    geometry); a plain GPT-2 run shows the name with empty tallies —
+    the section is stable schema, not adapter-conditional."""
+    import jax
+
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.inference.adapters import (LongContextAdapter,
+                                                  MoEAdapter)
+
+    moe = MoEAdapter.from_config(vocab_size=256, n_layer=2, n_head=2,
+                                 n_embd=32, n_positions=128, n_experts=4)
+    eng = InferenceEngine(None, moe.init_params(jax.random.PRNGKey(0)),
+                          config={"max_slots": 4, "max_len": 64,
+                                  "chunk_size": 4, "prefill_chunk": 8,
+                                  "max_queue": 64,
+                                  "use_flash_decode": False},
+                          adapter=moe)
+    _warm(eng)
+    spec = _spec(seed=2, n_requests=8, rate=200.0, vocab_size=256)
+    res = SustainedRunner(eng, spec, window_seconds=0.1,
+                          max_steps=100_000).run()
+    assert res.adapter == "moe" and sum(res.expert_load) > 0
+    rep = build_report(spec, res, SLO(ttft_p99_ms=1e4, itl_p99_ms=2e3))
+    sec = rep["adapter"]
+    assert sec["adapter"] == "moe"
+    assert len(sec["expert_load"]) == 4
+    assert sec["expert_load_imbalance"] >= 1.0
+    assert sec["sparse_token_fraction"] is None  # no sparse threshold
+    json.dumps(rep)
+    eng.close()
+
+    cfg, model, params = make_model()
+    lc = LongContextAdapter.from_model(model, threshold=32, block=8,
+                                       num_local_blocks=2)
+    eng = InferenceEngine(None, params,
+                          config={"max_slots": 4, "max_len": 64,
+                                  "chunk_size": 4, "prefill_chunk": 8,
+                                  "max_queue": 64,
+                                  "use_flash_decode": False},
+                          adapter=lc)
+    _warm(eng)
+    spec = _spec(seed=2, n_requests=6, rate=200.0,
+                 vocab_size=cfg.vocab_size, output_dist="fixed",
+                 output_mean=30, output_max=30)
+    res = SustainedRunner(eng, spec, window_seconds=0.1,
+                          max_steps=100_000).run()
+    sec = build_report(spec, res,
+                       SLO(ttft_p99_ms=1e4, itl_p99_ms=2e3))["adapter"]
+    assert sec["adapter"] == "longcontext"
+    assert sec["sparse_decode_threshold"] == 32
+    # Every stream runs prompt+30 tokens; those past position 32 are
+    # sparse-served — the fraction is exact, strictly inside (0, 1).
+    assert 0.0 < sec["sparse_token_fraction"] < 1.0
+    assert sec["expert_load"] == []
+    eng.close()
+
+    eng = engine_of(model, params)
+    _warm(eng)
+    res = SustainedRunner(eng, _spec(seed=2, n_requests=4, rate=200.0,
+                                     vocab_size=cfg.vocab_size),
+                          window_seconds=0.1, max_steps=100_000).run()
+    sec = build_report(_spec(seed=2), res,
+                       SLO(ttft_p99_ms=1e4, itl_p99_ms=2e3))["adapter"]
+    assert sec["adapter"] == "gpt2"
+    assert sec["expert_load"] == [] and sec["expert_load_imbalance"] is None
+    assert sec["sparse_decode_threshold"] == 0
+    assert sec["sparse_token_fraction"] is None
+    eng.close()
 
 
 # ------------------------------------------------------------- saturation
@@ -544,7 +648,7 @@ def test_bench_sustained_smoke_report():
     assert result["unit"] == "tokens/s/chip"
     assert result["value"] > 0
     rep = result["extra"]["sustained"]
-    assert rep["schema_version"] == 5
+    assert rep["schema_version"] == 6
     wins = rep["timeseries"]["windows"]
     carrying = [w for w in wins
                 if w["ttft_p99_ms"] is not None
@@ -618,7 +722,7 @@ def test_chaos_section_empty_on_fault_free_run():
     assert res.recovery == [] and res.requests_lost == 0
     assert res.faults_injected == 0
     rep = build_report(spec, res, SLO(ttft_p99_ms=1e4, itl_p99_ms=2e3))
-    assert rep["schema_version"] == 5
+    assert rep["schema_version"] == 6
     chaos = rep["chaos"]
     assert chaos["recoveries"] == 0 and chaos["recovery_time_s"] == 0.0
     assert chaos["requests_during_recovery"] == 0
@@ -645,7 +749,7 @@ def test_bench_chaos_smoke_report():
     assert extra["requests_lost"] == 0
     assert extra["recoveries"] >= 1 and extra["faults_injected"] >= 1
     rep = extra["chaos_report"]
-    assert rep["schema_version"] == 5
+    assert rep["schema_version"] == 6
     assert rep["chaos"]["requests_lost"] == 0
     assert rep["context"]["fault_plan"]["faults"][0]["kind"] == "raise"
 
