@@ -882,21 +882,40 @@ def _decode_attention_probe(engine, reps=10, s=1):
     g = engine._gcfg
     b = engine.config.max_slots
     h, d = g.n_head, g.n_embd // g.n_head
-    t = engine._pool["k"].shape[3]
     rng = np.random.RandomState(0)
-    q = jnp.asarray(rng.randn(b, h, s, d), g.dtype)
-    k = jnp.asarray(rng.randn(b, h, t, d), g.dtype)
-    v = jnp.asarray(rng.randn(b, h, t, d), g.dtype)
-    pos = jnp.full((b,), t - s, jnp.int32)
-    use_flash = bool(g.use_flash_decode) and da.decode_supported(t)
-    fn = da.flash_decode_attention if use_flash \
-        else da.decode_attention_reference
+    if "block_tbl" in engine._pool:
+        # Paged pool: probe the block-table kernel over a synthetic
+        # arena with every row's pages mapped (worst-case frontier),
+        # page 0 reserved as the trash page like the real arena.
+        page_len = int(engine._pool["k"].shape[3])
+        n_lp = int(engine._pool["block_tbl"].shape[1])
+        t = page_len * n_lp
+        q = jnp.asarray(rng.randn(b, h, s, d), g.dtype)
+        k = jnp.asarray(rng.randn(b * n_lp + 1, h, page_len, d), g.dtype)
+        v = jnp.asarray(rng.randn(b * n_lp + 1, h, page_len, d), g.dtype)
+        tbl = jnp.asarray(
+            np.arange(1, b * n_lp + 1, dtype=np.int32).reshape(b, n_lp))
+        pos = jnp.full((b,), t - s, jnp.int32)
+        use_flash = bool(g.use_flash_decode) and da.decode_supported(page_len)
+        fn = da.flash_decode_attention_paged if use_flash \
+            else da.decode_attention_paged_reference
+        args = (q, k, v, tbl, pos)
+    else:
+        t = engine._pool["k"].shape[3]
+        q = jnp.asarray(rng.randn(b, h, s, d), g.dtype)
+        k = jnp.asarray(rng.randn(b, h, t, d), g.dtype)
+        v = jnp.asarray(rng.randn(b, h, t, d), g.dtype)
+        pos = jnp.full((b,), t - s, jnp.int32)
+        use_flash = bool(g.use_flash_decode) and da.decode_supported(t)
+        fn = da.flash_decode_attention if use_flash \
+            else da.decode_attention_reference
+        args = (q, k, v, pos)
     jitted = jax.jit(fn)
-    jax.block_until_ready(jitted(q, k, v, pos))   # compile + warmup
+    jax.block_until_ready(jitted(*args))   # compile + warmup
     t0 = time.time()
     out = None
     for _ in range(reps):
-        out = jitted(q, k, v, pos)
+        out = jitted(*args)
     jax.block_until_ready(out)
     return (time.time() - t0) / reps * 1e3, use_flash
 
@@ -904,7 +923,7 @@ def _decode_attention_probe(engine, reps=10, s=1):
 def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
                      spec_decode=True, int8_kv=True, prefix_cache=True,
                      host_offload=True, sparse_decode=True,
-                     expert_parallel=True):
+                     expert_parallel=True, paged_kv=True):
     """Continuous-batching serving benchmark (deepspeed_tpu/inference/).
 
     A synthetic Poisson request stream plays against the slotted engine:
@@ -940,7 +959,10 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
     feature honor them (LongContextAdapter drops its threshold,
     MoEAdapter replicates its expert stacks) and the stock GPT-2
     adapter ignores them — the flag records which arm produced the
-    artifact either way."""
+    artifact either way. ``paged_kv`` serves through the page-granular
+    KV pool (``--no-paged-kv`` for the dense-pool A/B, suffixed
+    ``_nopagedkv``); it rides the chunked path only — page mapping
+    advances at the mixed-step boundary."""
     import jax
 
     import deepspeed_tpu as deepspeed
@@ -979,6 +1001,14 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
     serve_cfg["host_offload"] = offload_on
     serve_cfg["sparse_decode"] = bool(sparse_decode)
     serve_cfg["expert_parallel"] = bool(expert_parallel)
+    # Paged KV pool rides the chunked path only (config validation).
+    paged_on = bool(paged_kv and chunked_prefill)
+    serve_cfg["paged_kv"] = paged_on
+    if paged_on and not on_tpu:
+        # Smoke page quantum: small pages on the tiny plane so the
+        # arena holds more than one page per slot (the default 128
+        # would swallow the whole 64-position smoke plane).
+        serve_cfg["kv_page_len"] = 16
     if prefix_on and not on_tpu:
         # Tiny-plane smoke sizing: prefixes shorter than the 64-token
         # default so the prefix plane stays a sliver of the smoke pool.
@@ -1022,6 +1052,7 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
 
     t0 = time.time()
     submitted, reqs, done = 0, [], []
+    peak_pages, page_util = 0, None
     with profile_window("serving"):
         while len(done) < n_req:
             now = time.time() - t0
@@ -1034,6 +1065,15 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
                                0.0))
                 continue
             done.extend(engine.step())
+            if paged_on:
+                # Page utilization at PEAK occupancy (end-of-run the
+                # pool has drained and the ratio is vacuously 0).
+                st = engine.kv_page_stats()
+                if st["pages_in_use"] > peak_pages:
+                    peak_pages = st["pages_in_use"]
+                    page_util = (engine._live_tokens()
+                                 / float(st["pages_in_use"]
+                                         * st["page_len"]))
     wall = max(time.time() - t0, 1e-9)
 
     toks_out = sum(len(r.tokens) for r in reqs)
@@ -1073,12 +1113,24 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
     # step's ACTUAL query width (spec_k+1 under speculation: the verify
     # lane is the step shape the kernel serves).
     g = engine._gcfg
-    plane_len = int(engine._pool["k"].shape[3])
+    if paged_on:
+        # Arena planes are [L, P, H, page_len, D]; the logical per-row
+        # plane is page_len * pages-per-slot (block-table width).
+        page_len = int(engine._pool["k"].shape[3])
+        plane_len = page_len * int(engine._pool["block_tbl"].shape[1])
+    else:
+        page_len = None
+        plane_len = int(engine._pool["k"].shape[3])
     s_probe = engine.config.spec_k + 1 if spec_on else 1
     attn_ms, engaged = _decode_attention_probe(engine, s=s_probe)
-    block_k = da.planned_block_k(
-        serve_cfg["max_slots"], g.n_head, s_probe, plane_len,
-        g.n_embd // g.n_head, g.dtype) if engaged else None
+    if not engaged:
+        block_k = None
+    elif paged_on:
+        block_k = page_len   # kernel blocks == pages by construction
+    else:
+        block_k = da.planned_block_k(
+            serve_cfg["max_slots"], g.n_head, s_probe, plane_len,
+            g.n_embd // g.n_head, g.dtype)
     # Windowed snapshot: chunks/decode_seconds already exclude warmup.
     decode_steps = m["chunks"] * serve_cfg["chunk_size"]
     decode_s = m["decode_seconds"]
@@ -1103,6 +1155,8 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
         name += "_nosparsedecode"
     if not expert_parallel:
         name += "_noexpertparallel"
+    if not paged_kv:
+        name += "_nopagedkv"
     _note_trace(engine)
     return {
         "metric": name,
@@ -1138,6 +1192,12 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
             "adapter": m.get("adapter"),
             "sparse_decode": bool(sparse_decode),
             "expert_parallel": bool(expert_parallel),
+            "paged": paged_on,
+            "page_len": m.get("kv_page_len"),
+            "kv_pages_total": m.get("kv_pages_total"),
+            "kv_pages_peak": peak_pages if paged_on else None,
+            "kv_page_utilization": (round(page_util, 4)
+                                    if page_util is not None else None),
             "prefix_hit_rate": m.get("prefix_hit_rate"),
             "kv_bytes_per_slot": m.get("kv_bytes_per_slot"),
             "kv_bytes_aliased": m.get("kv_bytes_aliased"),
@@ -1165,7 +1225,7 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
 def main_serve(smoke=False, flash_decode=None, chunked_prefill=True,
                spec_decode=True, int8_kv=True, prefix_cache=True,
                host_offload=True, sparse_decode=True,
-               expert_parallel=True):
+               expert_parallel=True, paged_kv=True):
     if not smoke:
         _require_tpu_or_exit()
     _emit(_measure_serving(smoke=smoke, flash_decode=flash_decode,
@@ -1174,7 +1234,8 @@ def main_serve(smoke=False, flash_decode=None, chunked_prefill=True,
                            prefix_cache=prefix_cache,
                            host_offload=host_offload,
                            sparse_decode=sparse_decode,
-                           expert_parallel=expert_parallel))
+                           expert_parallel=expert_parallel,
+                           paged_kv=paged_kv))
     return 0
 
 
@@ -2148,6 +2209,10 @@ def _dispatch(argv):
     host_offload = "--no-host-offload" not in argv
     sparse_decode = "--no-sparse-decode" not in argv
     expert_parallel = "--no-expert-parallel" not in argv
+    # --no-paged-kv: the dense-pool side of the paged-KV A/B (default
+    # True — page-granular pool on; metric suffixed _nopagedkv so the
+    # series never mix).
+    paged_kv = "--no-paged-kv" not in argv
     prefix_affinity = "--no-prefix-affinity" not in argv
     disagg_ab = "--disagg" in argv or "--no-disagg" in argv
     disagg_on = "--no-disagg" not in argv
@@ -2183,14 +2248,16 @@ def _dispatch(argv):
                           int8_kv=int8_kv, prefix_cache=prefix_cache,
                           host_offload=host_offload,
                           sparse_decode=sparse_decode,
-                          expert_parallel=expert_parallel)
+                          expert_parallel=expert_parallel,
+                          paged_kv=paged_kv)
     if "--serve" in argv:
         return main_serve(flash_decode=flash_decode,
                           chunked_prefill=chunked, spec_decode=spec,
                           int8_kv=int8_kv, prefix_cache=prefix_cache,
                           host_offload=host_offload,
                           sparse_decode=sparse_decode,
-                          expert_parallel=expert_parallel)
+                          expert_parallel=expert_parallel,
+                          paged_kv=paged_kv)
     if "--sweep" in argv:
         return main_sweep()
     if "--xl-compute" in argv:

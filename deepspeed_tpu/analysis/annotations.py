@@ -53,6 +53,9 @@ HOT_PATH_FUNCTIONS = {
         "flash_decode_attention", "flash_decode_attention_q8",
         "quantize_kv", "dequantize_kv", "decode_attention_reference",
         "decode_attention_q8_reference",
+        "flash_decode_attention_paged", "flash_decode_attention_paged_q8",
+        "decode_attention_paged_reference",
+        "decode_attention_paged_q8_reference",
     }),
 }
 

@@ -12,6 +12,14 @@ jit binding, every donated argument that is a plain ``name`` or dotted
 the call (``pool = step(pool)``) or never read again before its next
 rebind in the same function. Textual order stands in for control flow —
 loops that wrap around are out of scope, as are aliases.
+
+Composite rebinds — the paged-KV page-arena pattern
+``self._pool = dict(self._pool, block_tbl=...)`` — both read and store
+the path in one statement. The read happens BEFORE the store takes
+effect, so it is only valid if some earlier statement already rebound
+the donated path; the statement's own store does not launder its own
+read. These "rebind-reads" are checked against stores strictly between
+the donating call and the rebinding statement.
 """
 
 import ast
@@ -86,10 +94,22 @@ def check(ctx, config):
                 loads, stores = _path_events(fnode, path)
                 next_store = min((s.lineno for s in stores
                                   if s.lineno > call.lineno), default=None)
-                bad = [l for l in loads
-                       if l.lineno > call.lineno
-                       and (next_store is None or l.lineno < next_store)
-                       and l is not argnode]
+                bad = []
+                for l in loads:
+                    if l.lineno <= call.lineno or l is argnode:
+                        continue
+                    lstmt = _stmt_of(ctx, l)
+                    if lstmt is not None and path in _target_paths(lstmt):
+                        # Rebind-read (``self._pool = dict(self._pool,
+                        # ...)``): the load sees the pre-statement value,
+                        # so a store must intervene strictly between the
+                        # donating call and this statement — the
+                        # statement's own store doesn't count.
+                        if not any(call.lineno < s.lineno < lstmt.lineno
+                                   for s in stores):
+                            bad.append(l)
+                    elif next_store is None or l.lineno < next_store:
+                        bad.append(l)
                 if bad:
                     first = min(bad, key=lambda n: (n.lineno, n.col_offset))
                     yield Finding(

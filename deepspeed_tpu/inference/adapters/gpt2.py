@@ -44,11 +44,22 @@ class GPT2Adapter(ModelAdapter):
     def bind(self, config, mesh=None):
         if config is None:
             return self
+        gcfg = self.gcfg
         flag = getattr(config, "use_flash_decode", None)
-        if flag is not None and bool(flag) != self.gcfg.use_flash_decode:
-            return dataclasses.replace(
-                self, gcfg=self.gcfg._replace(use_flash_decode=bool(flag)))
-        return self
+        if flag is not None and bool(flag) != gcfg.use_flash_decode:
+            gcfg = gcfg._replace(use_flash_decode=bool(flag))
+        # Paged cache-spec variant (``inference.paged_kv``): stamp the
+        # page quantum into the static cfg so the jit cache key names
+        # the layout — generation._forward itself dispatches on the
+        # cache's ``block_tbl`` key, but two engines serving dense and
+        # paged pools must never share a traced program.
+        page_len = (int(getattr(config, "kv_page_len", 0))
+                    if getattr(config, "paged_kv", False) else 0)
+        if page_len != gcfg.kv_page_len:
+            gcfg = gcfg._replace(kv_page_len=page_len)
+        if gcfg is self.gcfg:
+            return self
+        return dataclasses.replace(self, gcfg=gcfg)
 
     def init_cache(self, batch, max_len, dtype=None):
         return generation.init_cache(self.gcfg, batch, max_len, dtype)

@@ -76,6 +76,16 @@ class LongContextAdapter(GPT2Adapter):
             # A/B flag (bench --no-sparse-decode): plain dense decode.
             adapter = dataclasses.replace(
                 adapter, gcfg=adapter.gcfg._replace(sparse_threshold=0))
+        # Paged cache-spec variant — same stamp as GPT2Adapter.bind:
+        # the einsum path gathers the arena back to logical planes
+        # before the sparse mask applies, so block-sparse decode and
+        # the paged pool compose without a dedicated kernel.
+        page_len = (int(getattr(config, "kv_page_len", 0))
+                    if config is not None
+                    and getattr(config, "paged_kv", False) else 0)
+        if page_len != adapter.gcfg.kv_page_len:
+            adapter = dataclasses.replace(
+                adapter, gcfg=adapter.gcfg._replace(kv_page_len=page_len))
         return adapter
 
     def observe(self, snap, registry):

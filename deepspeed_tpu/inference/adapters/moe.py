@@ -231,6 +231,14 @@ class MoEAdapter(GPT2Adapter):
         # use_flash_decode is ignored: the MoE forward has no flash path
         # (gcfg.use_flash_decode stays False so the engine's metrics and
         # plane padding read the truth).
+        if config is not None and getattr(config, "paged_kv", False):
+            # The MoE forward reads its cache as contiguous planes and
+            # has no block-table gather — serving it from a page arena
+            # would silently attend garbage. Refuse loudly.
+            raise ValueError(
+                "inference.paged_kv is not supported by the MoE adapter "
+                "(its forward has no block-table path); serve MoE with "
+                "the dense KV pool")
         if config is not None:
             ep = bool(getattr(config, "expert_parallel", True))
             if ep != self.expert_parallel:

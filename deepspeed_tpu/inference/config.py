@@ -51,6 +51,9 @@ INFERENCE_DEFAULTS = {
     "role": "mixed",
     "sparse_decode": True,
     "expert_parallel": True,
+    "paged_kv": False,
+    "kv_page_len": 128,
+    "kv_pages": None,
 }
 
 
@@ -226,6 +229,31 @@ class InferenceConfig:
     # replicate instead of sharding over 'model' (the bench
     # --no-expert-parallel A/B arm).
     expert_parallel: bool = True
+    # --- Paged KV cache (inference/paging.py + kv_pool.py) --------------
+    # Store the KV plane as a shared PAGE ARENA [L, P, H, page_len, D]
+    # plus a per-slot int32 block table [slots, plane_len/page_len]:
+    # pages are allocated on demand as frontiers advance and freed at
+    # release, so a slot only ever holds HBM proportional to its actual
+    # length (vLLM-style paged attention under XLA static shapes — the
+    # arena and table SHAPES are fixed, only the table VALUES change,
+    # so the compiled step program never recompiles). Admission becomes
+    # page-aware: each request reserves ceil((prompt + max_new + slack)
+    # / page_len) pages up front, which is what turns the heavy-tailed
+    # length mix into a >= 3x concurrent-session win at fixed HBM.
+    # False (the default) keeps the dense slotted pool — the A/B arm
+    # and the training-side baseline.
+    paged_kv: bool = False
+    # Page length in positions — the block-table granularity AND the
+    # flash-decode block quantum (kernel blocks == pages; the Pallas
+    # paged kernel engages when this is a multiple of its 128-position
+    # BLOCK_MIN, the einsum gather path serves any value — small pages
+    # keep CPU tests cheap).
+    kv_page_len: int = 128
+    # Total pages in the arena (the HBM budget in page units). None
+    # derives capacity parity with the dense pool: max_slots *
+    # (plane_len / page_len) pages, i.e. the same bytes — set it lower
+    # to pin HBM and let page-aware admission carry more sessions.
+    kv_pages: Optional[int] = None
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -303,6 +331,18 @@ class InferenceConfig:
                 "inference.role={!r} requires chunked_prefill: the handoff "
                 "capture rides the mixed-step path (the legacy bucket path "
                 "has no step boundary to capture at)".format(self.role))
+        if self.kv_page_len < 1:
+            raise ValueError("inference.kv_page_len must be >= 1, got "
+                             "{}".format(self.kv_page_len))
+        if self.kv_pages is not None and self.kv_pages < 1:
+            raise ValueError("inference.kv_pages must be >= 1 (or None for "
+                             "dense-parity capacity), got "
+                             "{}".format(self.kv_pages))
+        if self.paged_kv and not self.chunked_prefill:
+            raise ValueError(
+                "inference.paged_kv=True requires chunked_prefill: page "
+                "mapping advances at the mixed-step boundary (the legacy "
+                "bucket path has no per-chunk frontier bookkeeping)")
         if self.hbm_budget_bytes is not None and self.hbm_budget_bytes <= 0:
             raise ValueError(
                 "inference.hbm_budget_bytes must be > 0 (or None for the "

@@ -98,11 +98,14 @@ from deepspeed_tpu.inference.kv_hierarchy import (
     KVHierarchy,
     capture_prefix_row,
     capture_slot,
+    capture_slot_paged,
     capture_slots,
+    capture_slots_paged,
     pick_swap_victim,
     record_nbytes,
     restore_prefix_row,
     restore_slot,
+    restore_slot_paged,
     spec_from_config,
 )
 from deepspeed_tpu.inference.kv_pool import (
@@ -111,6 +114,7 @@ from deepspeed_tpu.inference.kv_pool import (
     harvest_snapshot,
     init_pool,
     max_active_frontier,
+    paged_plane_len,
     plane_len_for,
     pool_nbytes,
     pool_shardings,
@@ -118,6 +122,7 @@ from deepspeed_tpu.inference.kv_pool import (
     slot_cache_view,
     write_slot_cache,
 )
+from deepspeed_tpu.inference.paging import PageAllocator
 from deepspeed_tpu.inference.adapters import GPT2Adapter
 from deepspeed_tpu.inference.scheduler import QueueFull, Scheduler
 from deepspeed_tpu.parallel import mesh as mesh_lib
@@ -465,6 +470,7 @@ class InferenceEngine(object):
     # (same ownership argument) or take a lock.
     _THREAD_OWNED = frozenset({
         "_pool",            # device KV pool; stepper-owned, rebound per step
+        "_pager",           # paged-pool allocator; same owner as _pool
         "_last_snap",       # last harvest snapshot (same owner as _pool)
         "_injector",        # fault plan, swapped between steps
         "_recovery_streak", "_last_swap_out_s",
@@ -545,11 +551,28 @@ class InferenceEngine(object):
         # frontier hint from this instead of paying a fresh device sync
         # per scrape; None until the first step and across pool rebuilds.
         self._last_snap = None
+        # Paged KV pool (``inference.paged_kv``): plane storage becomes
+        # a shared page arena + per-slot block tables (kv_pool paged
+        # layout), and this host-side allocator owns page lifetime —
+        # mapping at the step boundary, refcounted prefix sharing,
+        # page-aware admission. None keeps the dense slotted pool,
+        # bit-for-bit the pre-paging engine (the A/B default).
+        self._pager = None
+        if config.paged_kv:
+            p_len = paged_plane_len(self._gcfg, config.max_len, slack,
+                                    config.kv_page_len)
+            n_lp = p_len // config.kv_page_len
+            usable = config.kv_pages or config.max_slots * n_lp
+            self._pager = PageAllocator(config.max_slots, n_lp, usable,
+                                        config.kv_page_len)
+            plane_len = p_len
+        else:
+            plane_len = plane_len_for(self._gcfg, config.max_len, slack)
         if hspec.enabled:
             self._hier = KVHierarchy(
-                hspec, self._gcfg,
-                plane_len_for(self._gcfg, config.max_len, slack),
-                config.max_slots, config.hbm_budget_bytes)
+                hspec, self._gcfg, plane_len,
+                config.max_slots, config.hbm_budget_bytes,
+                pager=self._pager)
         self._tp = mesh is not None and mesh_lib.mp_size(mesh) > 1
         pool = self._build_pool()
         if self._tp:
@@ -669,6 +692,16 @@ class InferenceEngine(object):
             self._scheduler.occupancy)
         self.telemetry.gauge("kv_pool_bytes").set_fn(
             lambda: pool_nbytes(self._pool))
+        # Same footprint under the name the capacity dashboards key on:
+        # the one HBM number the paged-vs-dense capacity pin compares.
+        self.telemetry.gauge("kv_hbm_bytes").set_fn(
+            lambda: pool_nbytes(self._pool))
+        if self._pager is not None:
+            pg = self._pager
+            self.telemetry.gauge("kv_pages_in_use").set_fn(pg.pages_in_use)
+            self.telemetry.gauge("kv_pages_free").set_fn(pg.pages_free)
+            self.telemetry.gauge("kv_page_fragmentation").set_fn(
+                lambda: pg.fragmentation(self._live_tokens()))
         # Span-ring overflow as a live series: a truncated autopsy
         # (telemetry/autopsy.py hop_gaps) is detectable from the same
         # scrape that would have shown the alert, instead of silently
@@ -736,9 +769,20 @@ class InferenceEngine(object):
         shapes/dtypes/shardings the programs were traced with and the
         jit cache serves it untouched: recovery never recompiles
         (the recovery invariant's compile_count clause)."""
-        pool = init_pool(self._gcfg, self.config.max_slots,
-                         self.config.max_len, slack=self._slack,
-                         hier=self._hier.spec if self._hier else None)
+        if self._pager is not None:
+            # Allocator state described the pool being replaced — reset
+            # to zero-knowledge (all pages free, all rows at trash),
+            # which matches the zeroed block table init_pool builds.
+            self._pager.reset()
+            pool = init_pool(self._gcfg, self.config.max_slots,
+                             self.config.max_len, slack=self._slack,
+                             hier=self._hier.spec if self._hier else None,
+                             page_len=self.config.kv_page_len,
+                             num_pages=self._pager.total_pages)
+        else:
+            pool = init_pool(self._gcfg, self.config.max_slots,
+                             self.config.max_len, slack=self._slack,
+                             hier=self._hier.spec if self._hier else None)
         aux = self._adapter.aux_state()
         if aux:
             # Adapter-owned pool state (``aux_`` keys): threaded through
@@ -933,6 +977,18 @@ class InferenceEngine(object):
                 "prompt ({} tokens) + max_new_tokens ({}) exceeds "
                 "inference.max_len={}".format(prompt.size, max_new_tokens,
                                               self.config.max_len))
+        if self._pager is not None:
+            need = min(
+                self._pager.pages_for(int(prompt.size) + int(max_new_tokens)
+                                      + self._slack),
+                self._pager.pages_per_slot)
+            if need > self._pager.total_pages:
+                raise ValueError(
+                    "request needs {} KV pages (prompt {} + max_new {} + "
+                    "slack {} tokens at kv_page_len={}) but the page arena "
+                    "holds only {} — raise inference.kv_pages".format(
+                        need, prompt.size, max_new_tokens, self._slack,
+                        self.config.kv_page_len, self._pager.total_pages))
         if eos_token_id is None:
             eos_token_id = self.config.eos_token_id
         if spec_decode and self._spec is None:
@@ -970,6 +1026,19 @@ class InferenceEngine(object):
         before any swap has been timed) instead of the completions-rate
         guess — capacity appears on swap cadence, not completion
         cadence."""
+        if self._pager is not None and self._scheduler.queue:
+            # Page-aware triage: when the queue HEAD is blocked on page
+            # capacity (not merely slots), the shed is a PAGES shed —
+            # reclassify it and swap the completions-rate hint for the
+            # page-release-rate one, which is the cadence capacity will
+            # actually appear on.
+            head = self._scheduler.queue[0]
+            need = self._paged_required(head)
+            if not self._pager.can_reserve(need):
+                exc.reason = "pages"
+                exc.retry_after_s = round(
+                    self._pager.retry_after_s(
+                        need - self._pager.available()), 4)
         hier = self._hier
         if hier is None or not hier.spec.offload:
             return exc
@@ -995,9 +1064,18 @@ class InferenceEngine(object):
         emitted so far stay on the request. Returns False when it had
         already finished."""
         was_decoding = req.phase == "decoding" and req.slot is not None
+        had_slot = req.slot is not None and \
+            req.phase in ("prefilling", "decoding")
         slot = req.slot
         if not self._scheduler.cancel(req):
             return False
+        if self._pager is not None:
+            # Queued/swapped cancels hold no pages; a slotted cancel
+            # releases its row (decref — shared prefix pages live on)
+            # and any cancel drops the undrawn reservation balance.
+            if had_slot:
+                self._pager.free_slot(slot)
+            self._pager.release_reservation(req.rid)
         if self._hier is not None:
             # Unpin any prefix row and drop a swapped session's host
             # record (a swapped cancel has no slot to deactivate).
@@ -1053,7 +1131,10 @@ class InferenceEngine(object):
         histograms: the mean inter-token gap per request ((finish -
         first) / (tokens - 1)) is one observation — the same statistic
         _latency_percentiles always reported, now windowed."""
+        slot = req.slot
         self._scheduler.complete(req.slot)
+        if self._pager is not None:
+            self._free_slot_pages(slot, req.rid)
         if self._hier is not None:
             self._hier.on_release(req)
         self.counters["requests_completed"] += 1
@@ -1116,14 +1197,237 @@ class InferenceEngine(object):
                 inj.advance()
         return done
 
+    # ------------------------------------------------------ paged KV pool
+
+    def _paged_required(self, req):
+        """Pages covering the deepest frontier ``req`` can ever reach:
+        prompt + budget + the plane slack (chunked-prefill overshoot /
+        spec verify writes), clamped to the per-row table width. The
+        admission gate reserves exactly this, which is what makes
+        ``ensure_mapped`` infallible mid-stream."""
+        return min(
+            self._pager.pages_for(int(req.prompt.size)
+                                  + int(req.max_new_tokens) + self._slack),
+            self._pager.pages_per_slot)
+
+    def _live_tokens(self):
+        """Tokens actually resident across running sessions — the
+        numerator of the page-fragmentation gauge."""
+        total = 0
+        for r in self._scheduler.running.values():
+            if r.phase == "prefilling":
+                total += int(r.cursor)
+            else:
+                total += int(r.prompt.size) + len(r.tokens)
+        return total
+
+    def _ensure_paged_mappings(self, pf, n_valid, p_done):
+        """Step-boundary page mapping: back every position the coming
+        mixed step can WRITE, then rebind the device block table iff the
+        host copy changed (THE page-arena rebind — an eager host->device
+        upload of a [slots, n_lp] int32 array, zero recompiles). Writes
+        past what we map here land in the trash page by construction
+        (the table's unmapped entries are 0), so lookahead only needs to
+        cover positions a later read can see: the decode lane advances
+        each active slot at most chunk (or chunk * (spec_k+1) with
+        speculation) positions, the prefill lane n_valid positions at
+        the cursor."""
+        pager = self._pager
+        lookahead = self.config.chunk_size * (
+            (self.config.spec_k + 1) if self._spec is not None else 1)
+        if pf is not None:
+            upto = int(pf.cursor) + int(n_valid)
+            if p_done:
+                # The slot joins THIS step's decode lane right after its
+                # final slice — map its decode writes too.
+                upto += lookahead
+            pager.ensure_mapped(pf.slot, upto)
+        for slot, req in self._scheduler.running.items():
+            if req.phase != "decoding":
+                continue
+            pos = int(req.prompt.size) + len(req.tokens)
+            pager.ensure_mapped(slot, pos + lookahead)
+        if pager.dirty:
+            self._pool = dict(self._pool,
+                              block_tbl=jnp.asarray(pager.table))
+            pager.dirty = False
+
+    def _free_slot_pages(self, slot, rid):
+        """Release a finished/evicted row: pages deref (shared ones live
+        on under the store's or other rows' refs), the host table row
+        points at trash, any undrawn reservation returns to the pool.
+        The DEVICE row is stale until the next step's rebind — safe,
+        because every program call is preceded by _ensure_paged_mappings
+        and freed pages cannot be re-granted and re-bound without that
+        same rebind shipping this row's zeroing too."""
+        self._pager.free_slot(slot)
+        self._pager.release_reservation(rid)
+
+    def _capture_slot_record(self, slot):
+        """Slot capture through the pool-layout switch: paged pools
+        gather the row's LIVE pages (offload.capture_slot_paged), dense
+        pools slice the plane (offload.capture_slot). Either record
+        restores through _restore_slot_record on any replica with the
+        same layout."""
+        if self._pager is not None:
+            return capture_slot_paged(self._pool, slot,
+                                      self._pager.row_pages(slot))
+        return capture_slot(self._pool, slot)
+
+    def _restore_slot_record(self, slot, req, record):
+        """Restore a captured record into ``slot``. Paged: claim fresh
+        physical pages for the record's stack, re-reserve the request's
+        residual growth, scatter, and point the row at them. Returns
+        False when the arena cannot cover pages + residual reservation
+        right now (caller defers — capacity appears on page-release
+        cadence)."""
+        if self._pager is None:
+            self._pool = restore_slot(self._pool, slot, record)
+            return True
+        pager = self._pager
+        n_pages = int(record["k"].shape[1])
+        extra = max(0, self._paged_required(req) - n_pages)
+        if pager.available() < n_pages + extra:
+            return False
+        pages = pager.alloc_pages(n_pages)
+        pager.install_row(slot, pages)
+        if extra:
+            pager.reserve(req.rid, extra)
+        pager.bind_slot(slot, req.rid)
+        self._pool = restore_slot_paged(self._pool, slot, record, pages)
+        return True
+
+    def _capture_prefix_pages(self, row, depth):
+        """DONOR half of cross-replica prefix adoption, paged flavor:
+        gather prefix row ``row``'s refcounted pages out of the arenas
+        and lay them out as the SAME dense record format
+        capture_prefix_row ships ([L, H, span, D] planes, [L, H, span]
+        scales) — the fleet transport and the dense acceptor never see
+        the layout difference. Returns (span, record) or None when the
+        store row has no page payload (or it certifies fewer than
+        ``depth`` positions worth exporting)."""
+        payload = self._hier.store.payload.get(row)
+        if payload is None:
+            return None
+        pages, span = payload
+        span = min(int(span), int(depth))
+        if span <= 0:
+            return None
+        p = self._pager.page_len
+        n = -(-span // p)
+        idx = jnp.asarray(list(pages[:n]), jnp.int32)
+        arrs = {}
+        for src, dst in (("k", "pk"), ("v", "pv"),
+                         ("k_scale", "pk_scale"), ("v_scale", "pv_scale")):
+            if src not in self._pool:
+                continue
+            g = jnp.take(self._pool[src], idx, axis=1)  # [L, n, H, p, ...]
+            g = jnp.moveaxis(g, 2, 1)                   # [L, H, n, p, ...]
+            g = g.reshape(g.shape[:2] + (n * p,) + g.shape[4:])
+            arrs[dst] = g[:, :, :span]
+        return span, jax.device_get(arrs)
+
+    def _restore_prefix_pages(self, row, record):
+        """ACCEPTOR half, paged flavor: claim fresh pages for a shipped
+        prefix record (dense [L, H, span, ...] layout), scatter it into
+        the arenas page-shaped, and hang the page payload on the store
+        row — the next admission's COW install shares these pages
+        exactly like locally-prefilled ones. Returns False when the
+        arena cannot spare the pages without eating promised capacity
+        (alloc_pages refuses; the row stays payload-less and probes
+        miss it, which is safe)."""
+        pager = self._pager
+        span = int(record["pk"].shape[2])
+        p = pager.page_len
+        n = pager.pages_for(span)
+        pages = pager.alloc_pages(n)
+        if pages is None:
+            return False
+        idx = jnp.asarray(pages, jnp.int32)
+        pool = dict(self._pool)
+        for dst, src in (("k", "pk"), ("v", "pv"),
+                         ("k_scale", "pk_scale"), ("v_scale", "pv_scale")):
+            if src not in record or dst not in pool:
+                continue
+            val = jnp.asarray(record[src], pool[dst].dtype)
+            pad = n * p - span
+            if pad:
+                widths = [(0, 0)] * val.ndim
+                widths[2] = (0, pad)
+                val = jnp.pad(val, widths)
+            val = val.reshape(val.shape[:2] + (n, p) + val.shape[3:])
+            val = jnp.moveaxis(val, 2, 1)               # [L, n, H, p, ...]
+            pool[dst] = pool[dst].at[:, idx].set(val)
+        self._pool = pool
+        self._hier.store.payload[row] = (tuple(pages), span)
+        return True
+
+    def kv_page_stats(self):
+        """Paged-capacity snapshot for the front door's admission
+        predictor (None on a dense engine): total/free/in-use pages,
+        the page quantum, pages UNPROMISED (free minus outstanding
+        reservations — the only number safe to admit against), and the
+        mean per-request reservation so ``pages_available /
+        mean_reservation_pages`` estimates admissible sessions."""
+        pg = self._pager
+        if pg is None:
+            return None
+        reqs = [r for r in self._scheduler.running.values()]
+        if reqs:
+            mean_res = (sum(self._paged_required(r) for r in reqs)
+                        / float(len(reqs)))
+        else:
+            mean_res = float(pg.pages_per_slot)
+        return {
+            "pages_total": pg.total_pages,
+            "pages_free": pg.pages_free(),
+            "pages_in_use": pg.pages_in_use(),
+            "pages_available": pg.available(),
+            "page_len": pg.page_len,
+            "mean_reservation_pages": mean_res,
+        }
+
     def _admit(self):
         """One admission round, with the hierarchy's admission hook per
         admitted pair (prefix-trie probe; stamps pid/pbase and advances
-        the cursor past an aliased span)."""
-        pairs = self._scheduler.admissions()
+        the cursor past an aliased span). On a paged engine admission is
+        PAGE-AWARE: the queue head must be able to reserve its full
+        frontier bound in pages or the round stops (strict FIFO — no
+        starvation by smaller followers), and every admitted request's
+        mappings draw down its own reservation."""
+        gate = None
+        if self._pager is not None:
+            pager = self._pager
+
+            def gate(req):
+                need = self._paged_required(req)
+                if not pager.can_reserve(need):
+                    return False
+                pager.reserve(req.rid, need)
+                return True
+        pairs = self._scheduler.admissions(gate=gate)
+        if self._pager is not None:
+            for req, slot in pairs:
+                self._pager.bind_slot(slot, req.rid)
         if self._hier is not None:
             for req, slot in pairs:
                 self._pool = self._hier.on_admit(self._pool, req, slot)
+        if self._pager is not None and pairs:
+            # Pin each admitted slot's device frontier to its cursor NOW
+            # (eager scatter, after on_admit may have advanced cursors
+            # past an aliased span). Until its first prefill slice runs,
+            # the slot is FROZEN in the decode lane but still writes at
+            # its pinned pos — and in a paged pool that write goes
+            # through the slot's NEW block-table row, so a stale pos
+            # from the previous occupant could land inside a SHARED
+            # prefix page and corrupt every aliaser. Pinned at the
+            # cursor, the write lands at the slot's own frontier, where
+            # its own first slice overwrites it (the stale rule).
+            idx = jnp.asarray([slot for _, slot in pairs], jnp.int32)
+            cur = jnp.asarray([int(req.cursor) for req, _ in pairs],
+                              jnp.int32)
+            self._pool = dict(self._pool,
+                              pos=self._pool["pos"].at[idx].set(cur))
         return pairs
 
     def _swap_in_ready(self):
@@ -1142,7 +1446,12 @@ class InferenceEngine(object):
             t0 = time.time()
             slot = free[0]
             record = self._hier.swap_store.pop(req.rid)
-            self._pool = restore_slot(self._pool, slot, record)
+            if not self._restore_slot_record(slot, req, record):
+                # Paged arena can't back the record plus its residual
+                # reservation yet — put it back and wait for pages to
+                # free (dense restores never refuse).
+                self._hier.swap_store.put(req.rid, record)
+                break
             self._scheduler.swap_in(req, slot)
             self.counters["swap_ins"] += 1
             if req.rid in self._preempted_rids:
@@ -1159,6 +1468,14 @@ class InferenceEngine(object):
         are excluded — no same-step thrash."""
         cands = [r for r in self._scheduler.running.values()
                  if r.phase == "decoding" and r.rid not in exclude]
+        if self._pager is not None:
+            # Score by the TRUE reclaim value: live pages held, not the
+            # configured residual budget (a long-context session holding
+            # 40 pages outranks a fresh one holding 2).
+            live = {r.rid: len(self._pager.row_pages(r.slot))
+                    for r in cands}
+            return pick_swap_victim(cands, live_pages=live,
+                                    page_len=self._pager.page_len)
         return pick_swap_victim(cands)
 
     def _maybe_swap_out(self, resumed):
@@ -1181,10 +1498,15 @@ class InferenceEngine(object):
         t0 = time.time()
         # Capture BEFORE deactivating: the record must restore
         # active=True so the resumed slot decodes again.
-        record = capture_slot(self._pool, victim.slot)
+        record = self._capture_slot_record(victim.slot)
         hier.swap_store.put(victim.rid, record)
         self._pool = dict(self._pool, active=self._pool["active"]
                           .at[victim.slot].set(False))
+        if self._pager is not None:
+            # The record IS the session now — its pages free (shared
+            # prefix pages live on under their other refs) and its
+            # reservation drops; swap-in re-reserves the residual.
+            self._free_slot_pages(victim.slot, victim.rid)
         self._scheduler.swap_out(victim)
         self.counters["swap_outs"] += 1
         self._last_swap_out_s = time.time() - t0
@@ -1216,10 +1538,12 @@ class InferenceEngine(object):
         if not hier.swap_capacity_left():
             return False
         t0 = time.time()
-        record = capture_slot(self._pool, req.slot)
+        record = self._capture_slot_record(req.slot)
         hier.swap_store.put(req.rid, record)
         self._pool = dict(self._pool, active=self._pool["active"]
                           .at[req.slot].set(False))
+        if self._pager is not None:
+            self._free_slot_pages(req.slot, req.rid)
         self._scheduler.swap_out(req)
         self.counters["swap_outs"] += 1
         self.counters["preemptions"] += 1
@@ -1266,6 +1590,14 @@ class InferenceEngine(object):
         row, depth = self._hier.store.lookup(toks)
         if row is None or depth < self._hier.spec.min_prefix_len:
             return None
+        if self._pager is not None:
+            out = self._capture_prefix_pages(row, depth)
+            if out is None:
+                return None
+            span, record = out
+            if span < self._hier.spec.min_prefix_len:
+                return None
+            return tuple(toks[:span]), record
         return tuple(toks[:depth]), capture_prefix_row(
             self._pool, row, depth)
 
@@ -1290,7 +1622,11 @@ class InferenceEngine(object):
             self._hier.store.evictions - before)
         if row is None:
             return False  # every row pinned by live aliasers
-        self._pool = restore_prefix_row(self._pool, row, record)
+        if self._pager is not None:
+            if not self._restore_prefix_pages(row, record):
+                return False  # arena full; row stays payload-less
+        else:
+            self._pool = restore_prefix_row(self._pool, row, record)
         self.counters["prefix_adoptions"] += 1
         self.counters["prefix_bytes_shipped"] += record_nbytes(record)
         return True
@@ -1316,9 +1652,19 @@ class InferenceEngine(object):
             return
         slots = [r.slot for r in pending]
         t0 = time.time()
-        records = capture_slots(self._pool, slots)
+        if self._pager is not None:
+            page_lists = [self._pager.row_pages(s) for s in slots]
+            records = capture_slots_paged(self._pool, slots, page_lists)
+        else:
+            records = capture_slots(self._pool, slots)
         self._pool = dict(self._pool, active=self._pool["active"]
                           .at[jnp.asarray(slots, jnp.int32)].set(False))
+        if self._pager is not None:
+            # The records ARE the sessions now — the donor's pages and
+            # reservations free for the next prefill wave (begin_handoff
+            # below pops req.slot, so free by the list captured above).
+            for req, slot in zip(pending, slots):
+                self._free_slot_pages(slot, req.rid)
         for req, record in zip(pending, records):
             self._scheduler.begin_handoff(req)
             self._handoff_outbox.append((req, record, t0))
@@ -1363,6 +1709,25 @@ class InferenceEngine(object):
         free = self._scheduler.free_slot_ids()
         if not free:
             return None
+        # Layout guard for mixed fleets: a paged record's planes are
+        # page STACKS [L, n, H, page_len, D] (ndim 5), a dense record's
+        # a plane slice [L, H, T, D] (ndim 4). A mismatched shipment
+        # cannot restore here — refuse so the pump tries another
+        # acceptor or falls back to re-prefill on a survivor.
+        rec_ndim = np.asarray(record["k"]).ndim
+        if rec_ndim != (5 if self._pager is not None else 4):
+            return None
+        if self._pager is not None:
+            # Page-capacity peek BEFORE committing the adoption: the
+            # record's live pages plus the residual reservation the
+            # restored session will grow into.
+            limit = (len(spec["prompt"]) + int(spec["max_new_tokens"])
+                     + self._slack)
+            n_pages = int(record["k"].shape[1])
+            extra = max(0, min(self._pager.pages_for(limit),
+                               self._pager.pages_per_slot) - n_pages)
+            if self._pager.available() < n_pages + extra:
+                return None
         pbase = int(np.asarray(record["pbase"])) if "pbase" in record else 0
         if pbase > 0:
             # The slot's private plane only holds the suffix past the
@@ -1391,7 +1756,8 @@ class InferenceEngine(object):
             row = self._hier.on_handoff_in(req, pbase)
             record = dict(record)
             record["pid"] = np.int32(row)
-        self._pool = restore_slot(self._pool, slot, record)
+        # Pre-checked above on the paged path, so this cannot refuse.
+        self._restore_slot_record(slot, req, record)
         self.counters["handoffs_in"] += 1
         return req
 
@@ -1420,6 +1786,13 @@ class InferenceEngine(object):
             slot = frontier = n_valid = 0
             p_done, max_new, eos, temp, top_k, seed = False, 1, -1, 0.0, 0, 0
             p_spec = False
+
+        if self._pager is not None:
+            # Map every position this step can write, THEN rebind the
+            # device block table if the host copy moved — the one
+            # host->device upload that makes freed rows' zeroing and
+            # fresh mappings visible atomically before the program runs.
+            self._ensure_paged_mappings(pf, n_valid, p_done)
 
         if self._injector is not None:
             # A "raise" fault fires HERE, in place of the program call —
@@ -1759,7 +2132,23 @@ class InferenceEngine(object):
             "handoffs_in": c.window("handoffs_in"),
             "handoff_fallbacks": c.window("handoff_fallbacks"),
             "handoff_bytes_shipped": c.window("handoff_bytes_shipped"),
+            # Paged KV pool (``inference.paged_kv``): the capacity-pin
+            # numbers — arena footprint under the dashboards' key plus
+            # the page-level utilization story. ``paged_kv`` False means
+            # dense planes (the A/B default) and no page gauges follow.
+            "paged_kv": self._pager is not None,
+            "kv_hbm_bytes": pool_nbytes(self._pool),
         }
+        if self._pager is not None:
+            pg = self._pager
+            m.update({
+                "kv_page_len": pg.page_len,
+                "kv_pages_total": pg.total_pages,
+                "kv_pages_in_use": pg.pages_in_use(),
+                "kv_pages_free": pg.pages_free(),
+                "kv_page_fragmentation": round(
+                    pg.fragmentation(self._live_tokens()), 4),
+            })
         if self._spec is not None:
             hist = self._accept_hist - self._accept_base
             n = int(hist.sum())

@@ -50,6 +50,15 @@ class AdmissionController(object):
 
     # -------------------------------------------------------- evidence
 
+    def update_slots(self, n):
+        """Refresh the effective concurrent-session capacity. Dense
+        targets never move it (max_slots is static); a PAGED target's
+        capacity is page-budget-bound and floats with the live mix —
+        the front door feeds ``pages_available / mean_reservation``
+        here each poll so predict_e2e_s's per-session token rate
+        tracks the pool that actually exists."""
+        self.slots = max(1, int(n))
+
     def observe_poll(self, completed_total, tokens_total):
         """Feed cumulative target counters; rates come from deltas over
         wall time. Called opportunistically (every dispatch round) —

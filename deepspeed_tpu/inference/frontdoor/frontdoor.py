@@ -192,6 +192,40 @@ class FrontDoor(object):
                        for rep in self.target.replicas)
         return self.target.config.max_slots
 
+    def _page_stats(self):
+        """Aggregated kv_page_stats() across the target's engines, or
+        None when no engine serves a paged pool (dense targets, and
+        engines without the hook)."""
+        if self._is_fleet:
+            engines = [rep.engine for rep in self.target.replicas]
+        else:
+            engines = [self.target]
+        stats = [s for s in (getattr(e, "kv_page_stats", lambda: None)()
+                             for e in engines) if s is not None]
+        if not stats:
+            return None
+        return {
+            "pages_available": sum(s["pages_available"] for s in stats),
+            "mean_reservation_pages": max(
+                1.0, sum(s["mean_reservation_pages"] for s in stats)
+                / len(stats)),
+        }
+
+    def _capacity_bound(self):
+        """Concurrent-session capacity the admission predictor and the
+        cold batch gate reason against. Dense targets: the static slot
+        total. PAGED targets: pages AVAILABLE (free minus outstanding
+        reservations) over the mean per-session page reservation — the
+        number of admissible sessions the page budget actually carries,
+        which under long-context mixes is far below (or above) the slot
+        count. Occupied slots with few live pages no longer read as
+        exhausted capacity."""
+        stats = self._page_stats()
+        if stats is None:
+            return self._slot_total
+        return max(1, int(stats["pages_available"]
+                          / stats["mean_reservation_pages"]))
+
     def _offload_enabled(self):
         if self._is_fleet:
             return any(rep.engine.config.host_offload
@@ -258,6 +292,10 @@ class FrontDoor(object):
             return
         self._admission.observe_poll(counters["requests_completed"],
                                      counters["tokens_out"])
+        # Paged targets: capacity floats with the page budget — refresh
+        # the predictor's session-capacity input each poll (dense
+        # targets return the static slot total; a no-op update).
+        self._admission.update_slots(self._capacity_bound())
 
     def _predictor_evidence(self):
         """The admission predictor's state RIGHT NOW — copied onto the
@@ -529,7 +567,7 @@ class FrontDoor(object):
         if pred is not None and \
                 pred <= self.config.batch_headroom * self._strictest_budget_s:
             return True
-        bound = self.config.cold_depth or self._slot_total
+        bound = self.config.cold_depth or self._capacity_bound()
         batch_inflight = sum(
             1 for h in self._inflight
             if not self._classes[h.priority].is_latency

@@ -40,11 +40,14 @@ from deepspeed_tpu.inference.kv_hierarchy.offload import (  # noqa: F401
     HostSwapStore,
     capture_prefix_row,
     capture_slot,
+    capture_slot_paged,
     capture_slots,
+    capture_slots_paged,
     pick_swap_victim,
     record_nbytes,
     restore_prefix_row,
     restore_slot,
+    restore_slot_paged,
 )
 from deepspeed_tpu.inference.kv_hierarchy.prefix_cache import (  # noqa: F401
     PrefixStore,

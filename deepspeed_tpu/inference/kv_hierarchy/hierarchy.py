@@ -75,12 +75,19 @@ class KVHierarchy(object):
     zero-knowledge starting point and replay re-earns everything."""
 
     def __init__(self, spec, gcfg, plane_len, max_slots,
-                 hbm_budget_bytes=None, counters=None):
+                 hbm_budget_bytes=None, counters=None, pager=None):
         self.spec = spec
         self.plane_len = int(plane_len)
         self.max_slots = int(max_slots)
         self.hbm_budget_bytes = hbm_budget_bytes
         self.counters = _LocalCounters() if counters is None else counters
+        # PAGED pool (inference/paging.py): the prefix tier stops owning
+        # dedicated pk/pv planes and instead shares refcounted ARENA
+        # PAGES into aliasing slots' block-table rows (full pages
+        # outright, the straddle page copy-on-write). The allocator is
+        # the one authority on page lifetime; the store's row payload
+        # records which pages a row pins.
+        self.pager = pager
 
         hd = gcfg.n_embd // gcfg.n_head
         self._fp_itemsize = jnp.dtype(
@@ -94,6 +101,8 @@ class KVHierarchy(object):
                                     * hd * self._fp_itemsize * 2)
 
         self.store = PrefixStore(spec.prefix_slots) if spec.prefix else None
+        if self.store is not None and pager is not None:
+            self.store.on_evict = self._drop_prefix_pages
         self.swap_store = HostSwapStore(spec.swap_slots) if spec.offload \
             else None
         # Set by submit() when a QueueFull caller was told a swap would
@@ -106,12 +115,72 @@ class KVHierarchy(object):
 
     # ------------------------------------------------------ engine hooks
 
+    def _drop_prefix_pages(self, row, payload):
+        """PrefixStore on_evict hook (paged mode): a row's contents were
+        dropped — release its backing pages' store pin. Pages still
+        shared into live slots keep those slots' own references."""
+        pages, _span = payload
+        self.pager.decref(pages)
+
+    def _on_admit_paged(self, pool, req, slot):
+        """Paged admission: a trie hit shares the stored row's FULL
+        pages into the slot's block-table row outright (refcounted — no
+        bytes move) and COPY-ON-WRITES the straddle page, so partial-
+        prefix hits are safe: the slot's own prefill overwrites the
+        straddle's positions past the certified span before the frontier
+        reaches them. No ``prefix_len`` cap applies — dense mode caps
+        the aliased span at the dedicated prefix plane's length, but
+        here the shared bytes live in the same arena as everything else
+        and any stored depth is shareable."""
+        prompt = [int(t) for t in req.prompt]
+        row, depth = self.store.lookup(prompt)
+        payload = self.store.payload.get(row) if row is not None else None
+        # The lane must still prefill >= 1 token to sample the first
+        # output, so never alias the entire prompt.
+        span = min(depth, len(prompt) - 1)
+        if payload is not None:
+            pages, stored_span = payload
+            span = min(span, int(stored_span))
+        if payload is None or span < self.spec.min_prefix_len:
+            self.counters["prefix_misses"] += 1
+            ins = len(prompt) - 1
+            if ins >= self.spec.min_prefix_len:
+                self._pending_insert[req.rid] = ins
+            return pool
+        pager = self.pager
+        n_full = min(span // pager.page_len, len(pages))
+        self.store.acquire(row, req.rid)
+        self._attach_len[req.rid] = span
+        self._aliased_total += span * self._per_pos_bytes
+        self.counters["prefix_hits"] += 1
+        pager.install_shared(slot, pages[:n_full])
+        pool = dict(pool)
+        if span > n_full * pager.page_len and n_full < len(pages):
+            # Straddle page: private copy, eager arena-row copy of every
+            # plane (codes AND scales). Positions past ``span`` inside it
+            # are donor garbage the aliaser's own prefill overwrites.
+            src = int(pages[n_full])
+            dst = pager.cow_page(slot, src)
+            for name in ("k", "v", "k_scale", "v_scale"):
+                if name in pool:
+                    pool[name] = pool[name].at[:, dst].set(
+                        pool[name][:, src])
+        req.cursor = span  # prefill starts past the aliased span
+        if "toks" in pool:
+            # The n-gram drafter reads the ring; the aliased span was
+            # never prefilled by THIS slot, so write it by hand.
+            pool["toks"] = pool["toks"].at[slot, :span].set(
+                jnp.asarray(prompt[:span], jnp.int32))
+        return pool
+
     def on_admit(self, pool, req, slot):
         """Admission hook: probe the trie, attach or record an insert
         intent, and stamp the slot's pid/pbase. Eager pool updates only
         — the traced programs see pid/pbase as ordinary donated inputs."""
         if self.store is None:
             return pool
+        if self.pager is not None:
+            return self._on_admit_paged(pool, req, slot)
         prompt = [int(t) for t in req.prompt]
         row, depth = self.store.lookup(prompt)
         # The lane must still prefill >= 1 token to sample the first
@@ -154,6 +223,20 @@ class KVHierarchy(object):
         if row is None:  # every row pinned by live aliasers
             return pool
         slot = req.slot
+        if self.pager is not None:
+            # Paged publish: no copy at all — the slot's own pages
+            # covering [:span] BECOME the stored row (incref is the
+            # store's pin; they outlive the donor slot). Donor writes
+            # >= span only touch the straddle page, which sharers COW.
+            n = -(-span // self.pager.page_len)
+            pages = self.pager.row_pages(slot)[:n]
+            if len(pages) < n:
+                return pool  # prefill never mapped that far (cancelled?)
+            self.pager.incref(pages)
+            self.store.payload[row] = (tuple(int(p) for p in pages),
+                                       int(span))
+            self.counters["prefix_inserts"] += 1
+            return pool
         pool = dict(pool)
         for plane, prefix in (("k", "pk"), ("v", "pv"),
                               ("k_scale", "pk_scale"),
@@ -218,6 +301,11 @@ class KVHierarchy(object):
     def prefix_store_bytes(self):
         if self.store is None:
             return 0
+        if self.pager is not None:
+            # Paged: no dedicated prefix planes — the store's cost is
+            # exactly the arena pages its row payloads pin, live.
+            pages = sum(len(p) for p, _ in self.store.payload.values())
+            return pages * self.pager.page_len * self._per_pos_bytes
         return (self.spec.prefix_slots * self.spec.prefix_len
                 * self._per_pos_bytes)
 

@@ -100,27 +100,115 @@ def restore_slot(pool, slot, record):
     return pool
 
 
-def pick_swap_victim(candidates, now=None,
-                     idle_weight=IDLE_WEIGHT_TOKENS_PER_S):
-    """The decoding session that can best afford to wait: remaining
-    budget BLENDED with last-touch age, not budget order alone.
+# ------------------------------------------------------- paged variants
+#
+# A PAGED pool (inference/kv_pool.py paged layout) keeps k/v as page
+# arenas [L, P, H, page_len, D]: a slot's device footprint is not a
+# contiguous plane slice but the set of physical pages its block-table
+# row names, so capture/restore take the explicit page list from the
+# PageAllocator. Records ship ONLY LIVE PAGES — a 100-token session in a
+# 2048-position plane moves ~1 page per layer, not the whole plane — as
+# [L, n_pages, H, page_len, D] stacks plus the same per-slot scalars as
+# the dense record. ``block_tbl`` never ships: it is host-owned derived
+# state the allocator rebuilds at restore (the record's page ORDER is
+# the row's logical order, which is all restore needs).
 
-    Score = (max_new_tokens - emitted) + idle_weight * seconds-since-
-    last-token; highest score is the victim, oldest rid on exact ties.
-    A large residual budget means many decode steps left to amortize
-    the swap; a stale last-touch means the session is not producing and
-    parking it costs nobody latency. Requests without a ``last_touch``
-    stamp score age 0 (budget-only — the pre-blend behavior)."""
+
+def capture_slot_paged(pool, slot, pages):
+    """Snapshot one paged slot — its ``pages`` (logical order) gathered
+    from the arenas plus its scalars/ring row — in one device_get."""
+    slot = int(slot)
+    idx = jnp.asarray([int(p) for p in pages], jnp.int32)
+    arrs = {}
+    for name, arr in pool.items():
+        if name == "block_tbl" or name.startswith("aux_"):
+            continue
+        if name in _PLANE_KEYS:
+            arrs[name] = jnp.take(arr, idx, axis=1)
+        else:
+            arrs[name] = arr[slot]
+    return jax.device_get(arrs)
+
+
+def capture_slots_paged(pool, slots, page_lists):
+    """Snapshot several paged slots in ONE batched transfer (the
+    disaggregated-handoff transport — mirrors capture_slots). All
+    slots' pages concatenate into one gather; the per-slot split
+    happens host-side after the single device_get."""
+    counts = [len(p) for p in page_lists]
+    flat = [int(p) for lst in page_lists for p in lst]
+    pidx = jnp.asarray(flat, jnp.int32)
+    sidx = jnp.asarray([int(s) for s in slots], jnp.int32)
+    arrs = {}
+    for name, arr in pool.items():
+        if name == "block_tbl" or name.startswith("aux_"):
+            continue
+        if name in _PLANE_KEYS:
+            arrs[name] = jnp.take(arr, pidx, axis=1)
+        else:
+            arrs[name] = arr[sidx]
+    host = jax.device_get(arrs)
+    records = []
+    off = 0
+    for i, n in enumerate(counts):
+        records.append({name: (val[:, off:off + n]
+                               if name in _PLANE_KEYS else val[i])
+                        for name, val in host.items()})
+        off += n
+    return records
+
+
+def restore_slot_paged(pool, slot, record, pages):
+    """Write a paged record back: plane stacks scatter into the FRESH
+    physical ``pages`` (len == the record's page count; the caller's
+    allocator already owns them and will point the slot's table row at
+    them), scalars into ``slot``. The physical pages need not match the
+    captured ones — like the dense restore, every positional fact
+    travels in the record."""
+    slot = int(slot)
+    idx = jnp.asarray([int(p) for p in pages], jnp.int32)
+    pool = dict(pool)
+    for name, val in record.items():
+        val = jnp.asarray(val, pool[name].dtype)
+        if name in _PLANE_KEYS:
+            pool[name] = pool[name].at[:, idx].set(val)
+        else:
+            pool[name] = pool[name].at[slot].set(val)
+    return pool
+
+
+def pick_swap_victim(candidates, now=None,
+                     idle_weight=IDLE_WEIGHT_TOKENS_PER_S,
+                     live_pages=None, page_len=0):
+    """The decoding session that can best afford to wait: reclaim value
+    BLENDED with last-touch age, not budget order alone.
+
+    Dense pools reclaim a fixed-size slot whoever the victim is, so the
+    reclaim term is the CONFIGURED residual budget (max_new_tokens -
+    emitted): many decode steps left to amortize the swap. A PAGED pool
+    reclaims exactly the victim's live pages — pass ``live_pages`` (rid
+    -> pages held) and ``page_len`` and the reclaim term becomes pages *
+    page_len, the TRUE token-capacity the eviction frees: a long-context
+    session holding 40 pages outranks a fresh one holding 2 whatever
+    their configured budgets say.
+
+    Score = reclaim + idle_weight * seconds-since-last-token; highest
+    score is the victim, oldest rid on exact ties. A stale last-touch
+    means the session is not producing and parking it costs nobody
+    latency. Requests without a ``last_touch`` stamp score age 0."""
     if not candidates:
         return None
     if now is None:
         now = time.time()
 
     def _key(r):
-        budget = r.max_new_tokens - len(r.tokens)
+        if live_pages is not None:
+            reclaim = live_pages.get(r.rid, 0) * page_len
+        else:
+            reclaim = r.max_new_tokens - len(r.tokens)
         touched = getattr(r, "last_touch", None)
         age = 0.0 if touched is None else max(0.0, now - touched)
-        return (budget + idle_weight * age, -r.rid)
+        return (reclaim + idle_weight * age, -r.rid)
 
     return max(candidates, key=_key)
 

@@ -67,7 +67,7 @@ class PrefixStore:
     least-recently-used unpinned row.
     """
 
-    def __init__(self, num_rows):
+    def __init__(self, num_rows, on_evict=None):
         self.num_rows = int(num_rows)
         self.tokens = {}      # row -> stored token tuple
         self.refcount = {}    # row -> live aliasing requests
@@ -81,6 +81,20 @@ class PrefixStore:
         # (the fleet's prefix directory syncs only when this moves;
         # acquire/release touch refcounts, not contents, and don't bump).
         self.version = 0
+        # Backing-storage attachment per row. The DENSE prefix pool
+        # needs none (row id IS the pk/pv plane row); the PAGED pool
+        # hangs (pages tuple, span) here — the refcounted arena pages
+        # holding the row's k/v and how many positions they certify.
+        # ``on_evict(row, payload)`` fires whenever a payload-bearing
+        # row's contents are dropped (eviction-reuse or reset) so the
+        # owner can release the backing pages; settable post-init.
+        self.payload = {}
+        self.on_evict = on_evict
+
+    def _drop_payload(self, row):
+        payload = self.payload.pop(row, None)
+        if payload is not None and self.on_evict is not None:
+            self.on_evict(row, payload)
 
     def _touch(self, row):
         self._tick += 1
@@ -114,6 +128,7 @@ class PrefixStore:
                 return None
             row = min(unpinned, key=lambda r: self.last_use.get(r, 0))
             del self.tokens[row]
+            self._drop_payload(row)
             self.evictions += 1
         self.tokens[row] = tokens
         self.refcount.setdefault(row, 0)
@@ -123,6 +138,8 @@ class PrefixStore:
         return row
 
     def reset(self):
+        for row in list(self.payload):
+            self._drop_payload(row)
         self.tokens.clear()
         self.refcount.clear()
         self.last_use.clear()

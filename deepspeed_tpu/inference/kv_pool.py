@@ -122,7 +122,18 @@ def plane_len_for(gcfg, max_len, slack=0):
     return max_len + slack
 
 
-def init_pool(gcfg, num_slots, max_len, dtype=None, slack=0, hier=None):
+def paged_plane_len(gcfg, max_len, slack, page_len):
+    """Logical plane length of one paged row: the dense plane length
+    rounded UP to a whole number of pages, so the gathered logical plane
+    ``[n_pages * page_len]`` covers every dense position (the
+    bit-identity argument needs gathered and dense mask extents to
+    agree; the round-up tail is inert padding like the block quantum)."""
+    plane_len = plane_len_for(gcfg, max_len, slack)
+    return -(-plane_len // page_len) * page_len
+
+
+def init_pool(gcfg, num_slots, max_len, dtype=None, slack=0, hier=None,
+              page_len=0, num_pages=None):
     """Zeroed pool pytree for ``num_slots`` sequences of up to ``max_len``
     positions under generation config ``gcfg`` (models.generation.as_gencfg).
     The allocated plane length is ``plane_len_for(gcfg, max_len, slack)``.
@@ -139,14 +150,41 @@ def init_pool(gcfg, num_slots, max_len, dtype=None, slack=0, hier=None):
       per-slot ``pid`` (aliased row, -1 detached) and ``pbase`` (aliased
       span; positions < pbase resolve to the prefix row). pbase==0 makes
       a stale pid inert, so -1 needs no special casing in the programs.
+
+    ``page_len > 0`` selects the PAGED layout instead: ``k``/``v``
+    become a shared page arena ``[L, P, H, page_len, D]`` (physical
+    page 0 is the reserved trash page — inference/paging.py) and the
+    pool gains an int32 ``block_tbl`` [slots, plane_len / page_len]
+    mapping each slot's logical pages to arena pages. ``num_pages``
+    sizes the usable arena (None: dense-parity — ``num_slots`` rows'
+    worth of pages). The prefix planes are NOT allocated in paged mode
+    even under ``hier.prefix``: prefix sharing happens by installing
+    refcounted pages into block tables (copy-on-write for the straddle
+    page), so the shared content lives in the one arena.
     """
     dtype = dtype or gcfg.dtype
     hd = gcfg.n_embd // gcfg.n_head
+    int8 = hier is not None and hier.int8
+    kv_dtype = jnp.int8 if int8 else dtype
+    if page_len:
+        plane_len = paged_plane_len(gcfg, max_len, slack, page_len)
+        n_lp = plane_len // page_len
+        usable = num_pages if num_pages is not None else num_slots * n_lp
+        P = usable + 1  # + the trash page at index 0
+        kv_shape = (gcfg.n_layer, P, gcfg.n_head, page_len, hd)
+        pool = {"k": jnp.zeros(kv_shape, kv_dtype),
+                "v": jnp.zeros(kv_shape, kv_dtype),
+                "block_tbl": jnp.zeros((num_slots, n_lp), jnp.int32),
+                "toks": jnp.zeros((num_slots, plane_len), jnp.int32)}
+        if int8:
+            pool["k_scale"] = jnp.zeros(kv_shape[:-1], jnp.float32)
+            pool["v_scale"] = jnp.zeros(kv_shape[:-1], jnp.float32)
+        for name, ft, fill in _SLOT_FIELDS:
+            pool[name] = jnp.full((num_slots,), fill, ft)
+        return pool
     plane_len = plane_len_for(gcfg, max_len, slack)
     if getattr(gcfg, "use_flash_decode", False):
         assert decode_attention.decode_supported(plane_len), plane_len
-    int8 = hier is not None and hier.int8
-    kv_dtype = jnp.int8 if int8 else dtype
     kv_shape = (gcfg.n_layer, num_slots, gcfg.n_head, plane_len, hd)
     pool = {"k": jnp.zeros(kv_shape, kv_dtype),
             "v": jnp.zeros(kv_shape, kv_dtype),
@@ -223,8 +261,14 @@ def cache_view(pool):
     planes pass through, and each slot's aliased prefix row is GATHERED
     to a per-slot ``pk``/``pv`` [L, S, H, prefix_len, D] view — the
     clip makes a detached pid (-1) gather row 0 harmlessly, because its
-    pbase of 0 selects none of it."""
+    pbase of 0 selects none of it.
+
+    PAGED pools pass the arenas WHOLE (no slot axis to slice — _forward
+    scatters and gathers through ``block_tbl``); the table and the
+    frontiers ride along as traced values."""
     cache = {"k": pool["k"], "v": pool["v"], "pos": pool["pos"]}
+    if "block_tbl" in pool:
+        cache["block_tbl"] = pool["block_tbl"]
     if "k_scale" in pool:
         cache["k_scale"] = pool["k_scale"]
         cache["v_scale"] = pool["v_scale"]
@@ -249,7 +293,21 @@ def slot_cache_view(pool, slot, pos):
     """ONE slot's k/v as a batch-1 cache dict for the prefill lane:
     plane slices (and scale slices when int8) along the slot axis, plus
     the slot's gathered prefix row when the pool carries one. ``slot``
-    may be traced; ``pos`` is the [1]-shaped append frontier."""
+    may be traced; ``pos`` is the [1]-shaped append frontier.
+
+    PAGED pools carry the arenas whole (the scatter/gather indirection
+    replaces the slot slice) with the one slot's block-table row."""
+    if "block_tbl" in pool:
+        cache = {"k": pool["k"], "v": pool["v"], "pos": pos,
+                 "block_tbl": jax.lax.dynamic_slice_in_dim(
+                     pool["block_tbl"], slot, 1, axis=0)}
+        if "k_scale" in pool:
+            cache["k_scale"] = pool["k_scale"]
+            cache["v_scale"] = pool["v_scale"]
+        for name in pool:
+            if name.startswith("aux_"):
+                cache[name] = pool[name]
+        return cache
     cache = {"k": jax.lax.dynamic_slice_in_dim(pool["k"], slot, 1, axis=1),
              "v": jax.lax.dynamic_slice_in_dim(pool["v"], slot, 1, axis=1),
              "pos": pos}
@@ -283,7 +341,22 @@ def write_slot_cache(pool, slot, cache):
     """Fold a ``slot_cache_view`` batch-1 cache back into the pool.
     Only the slot's WRITABLE state returns: k/v (+ scales); the prefix
     planes are read-only to aliasers and ``pos`` install stays with the
-    caller (the lane's conditional slot-field writes)."""
+    caller (the lane's conditional slot-field writes).
+
+    PAGED pools fold the arenas back WHOLESALE: _forward scattered the
+    slot's writes through the block table into the arena copy it was
+    handed, so the updated arena IS the pool's new truth. The table
+    itself never folds back — it is host-owned (inference/paging.py)
+    and the device only reads it."""
+    if "block_tbl" in pool:
+        pool = dict(pool)
+        for name in ("k", "v", "k_scale", "v_scale"):
+            if name in pool:
+                pool[name] = cache[name]
+        for name in cache:
+            if name.startswith("aux_"):
+                pool[name] = cache[name]
+        return pool
     pool = dict(pool)
     for name in ("k", "v", "k_scale", "v_scale"):
         if name in pool:

@@ -241,21 +241,28 @@ class Scheduler(object):
                 return hint
         return self._rate_hint(self._finish_times)
 
-    def queue_full_error(self, reason=None, priority=None, tenant=None):
+    def queue_full_error(self, reason=None, priority=None, tenant=None,
+                         cause=None, retry_after_s=None):
         """The structured QueueFull for the CURRENT queue state — also
         built by the engine for admission-pressure sheds (injected
-        faults, drain) so every shed carries the same backpressure
-        fields. ``priority`` selects the class-aware hint and is stamped
-        on the error along with ``tenant``."""
+        faults, drain, paged-pool page exhaustion) so every shed carries
+        the same backpressure fields. ``priority`` selects the
+        class-aware hint and is stamped on the error along with
+        ``tenant``. ``cause`` overrides the structured ``reason`` field
+        (default ``queue_full``; the paged admission gate sheds with
+        ``pages``) and ``retry_after_s`` overrides the completions-rate
+        hint with a better-informed one (the page-release-rate estimate
+        — paging.PageAllocator.retry_after_s)."""
         depth = len(self.queue)
-        hint = self.retry_after_s(priority)
+        hint = retry_after_s if retry_after_s is not None \
+            else self.retry_after_s(priority)
         msg = reason or ("inference queue is full ({} pending); retry "
                          "later or raise inference.max_queue".format(depth))
         if hint is not None:
             msg += " (retry_after_s hint: {})".format(hint)
         return QueueFull(msg, queue_depth=depth, retry_after_s=hint,
                          replica_id=self.replica_id, priority=priority,
-                         tenant=tenant, reason="queue_full")
+                         tenant=tenant, reason=cause or "queue_full")
 
     def submit(self, prompt, max_new_tokens, temperature, top_k,
                eos_token_id, seed, spec=False, deadline=None,
@@ -308,7 +315,7 @@ class Scheduler(object):
                                  phase="expired")
         return expired
 
-    def admissions(self):
+    def admissions(self, gate=None):
         """FIFO: pop (request, slot) pairs for every free slot while the
         queue lasts, moving each request into the ``prefilling`` phase
         (admit_time stamped — queue-wait ends here). BOTH engine paths
@@ -320,11 +327,19 @@ class Scheduler(object):
         change. Expired-deadline requests are shed before slots are
         filled; a replayed request (recovery re-admission) keeps its
         FIRST admit_time, so queue-wait is observed exactly once per
-        request."""
+        request.
+
+        ``gate``: optional callable(Request) -> bool consulted on the
+        queue HEAD before it pops — the paged engine's page-reservation
+        check. A rejected head ENDS the round (strict FIFO: younger
+        requests must not jump a head that is merely waiting for pages
+        to free — the same no-starvation rule the slot FIFO enforces)."""
         self.expire_deadlines()
         pairs = []
         for slot in self.free_slot_ids():
             if not self.queue:
+                break
+            if gate is not None and not gate(self.queue[0]):
                 break
             req = self.queue.popleft()
             first_admission = req.admit_time is None

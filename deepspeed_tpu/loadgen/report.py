@@ -24,7 +24,7 @@ import math
 
 from deepspeed_tpu.loadgen import slo as slo_mod
 
-SCHEMA_VERSION = 6  # v2: + chaos section (recovery/requests_lost) and
+SCHEMA_VERSION = 7  # v2: + chaos section (recovery/requests_lost) and
 # per-sample terminal phase. v3: + prefix section (hit rate, bytes
 # shipped by cross-replica adoption, affinity-routed count). v4: +
 # disagg section (prefill->decode handoff counts, fallbacks, bytes
@@ -32,8 +32,10 @@ SCHEMA_VERSION = 6  # v2: + chaos section (recovery/requests_lost) and
 # reason, per-tenant tallies, preemption counts) and per-sample
 # priority/tenant/shed_reason keys. v6: + adapter section (which
 # ModelAdapter served the run, MoE expert-load balance, the sparse-
-# attention token fraction, offloaded-page counts) — each additive, but
-# comparisons across versions deserve the gate's schema caveat.
+# attention token fraction, offloaded-page counts). v7: + paged section
+# (page-granular KV pool facts: page quantum, arena size, peak pages
+# in use, utilization at peak) — each additive, but comparisons across
+# versions deserve the gate's schema caveat.
 
 # Gate polarity: which direction is a REGRESSION for each report
 # metric. Lower-is-better latencies only fail when they grow;
@@ -262,6 +264,24 @@ def _adapter_section(result):
     }
 
 
+def _paged_section(result):
+    """Paged-KV facts for the run (schema v7; stable schema — a dense
+    engine shows ``paged: false`` with zero/null tallies). The numbers
+    are the runner's poll of ``engine.kv_page_stats()``: the page
+    quantum and arena size are static, ``pages_peak`` is the high-water
+    page count across the run's steps, and ``page_utilization`` is
+    live tokens over mapped capacity AT that peak — the fragmentation
+    bound that says how much of the claimed HBM actually held KV."""
+    util = getattr(result, "kv_page_utilization", None)
+    return {
+        "paged": bool(getattr(result, "paged", False)),
+        "page_len": int(getattr(result, "kv_page_len", 0) or 0),
+        "pages_total": int(getattr(result, "kv_pages_total", 0) or 0),
+        "pages_peak": int(getattr(result, "kv_pages_peak", 0) or 0),
+        "page_utilization": None if util is None else round(float(util), 6),
+    }
+
+
 def build_report(spec, result, slo, chips=1, platform=None, extra=None,
                  class_slos=None):
     """Fold one RunResult into the report document.
@@ -311,6 +331,7 @@ def build_report(spec, result, slo, chips=1, platform=None, extra=None,
         "disagg": _disagg_section(result),
         "frontdoor": _frontdoor_section(result, slo, class_slos),
         "adapter": _adapter_section(result),
+        "paged": _paged_section(result),
         "timeseries": {
             "window_seconds": result.collector.window_seconds,
             "windows_total": result.collector._idx,

@@ -92,6 +92,15 @@ class RunResult:
     sparse_decode_threshold: int = 0
     swap_outs: int = 0
     swap_ins: int = 0
+    # Paged-KV facts (schema v7; false/zero on dense engines): the page
+    # quantum and arena size from ``engine.kv_page_stats()``, the
+    # high-water page count across the run, and live-tokens-over-mapped-
+    # capacity at that peak (the fragmentation bound).
+    paged: bool = False
+    kv_page_len: int = 0
+    kv_pages_total: int = 0
+    kv_pages_peak: int = 0
+    kv_page_utilization: float = None
 
 
 def _sample_row(lr, req, shed_reason=None):
@@ -196,6 +205,12 @@ class SustainedRunner(object):
             return 0
 
         faults_at_start = _counter("faults_injected")
+        # Paged-KV poll state: kv_page_stats is the single-engine
+        # surface (a fleet aggregates per-replica; its report rows stay
+        # at the dense defaults), _live_tokens the utilization numerator.
+        page_stats_fn = getattr(self.engine, "kv_page_stats", None)
+        live_tokens_fn = getattr(self.engine, "_live_tokens", None)
+        pages_peak, page_util = 0, None
         prefix_at_start = {n: _counter(n) for n in (
             "prefix_hits", "prefix_misses", "prefix_bytes_shipped",
             "affinity_routed", "handoffs", "handoff_fallbacks",
@@ -241,6 +256,14 @@ class SustainedRunner(object):
             else:
                 self.engine.step()
                 steps += 1
+                if page_stats_fn is not None:
+                    pst = page_stats_fn()
+                    if pst is not None and pst["pages_in_use"] > pages_peak:
+                        pages_peak = pst["pages_in_use"]
+                        if live_tokens_fn is not None:
+                            page_util = (live_tokens_fn() /
+                                         float(pst["pages_in_use"] *
+                                               pst["page_len"]))
                 if self.max_steps is not None and steps > self.max_steps:
                     raise RuntimeError(
                         "sustained run exceeded max_steps={} with {} "
@@ -277,6 +300,8 @@ class SustainedRunner(object):
         # adapter instance; per-expert dispatch gauges summed across
         # replicas out of the registry snapshot (keys look like
         # ``moe_expert_load{expert=2,replica=0}`` on a fleet).
+        final_page_stats = (None if page_stats_fn is None
+                            else page_stats_fn())
         adapter_obj = getattr(self.engine, "adapter", None)
         expert_load = {}
         reg = getattr(self.engine, "telemetry", None)
@@ -331,4 +356,11 @@ class SustainedRunner(object):
             swap_outs=_counter("swap_outs")
             - prefix_at_start["swap_outs"],
             swap_ins=_counter("swap_ins")
-            - prefix_at_start["swap_ins"])
+            - prefix_at_start["swap_ins"],
+            paged=final_page_stats is not None,
+            kv_page_len=(0 if final_page_stats is None
+                         else int(final_page_stats["page_len"])),
+            kv_pages_total=(0 if final_page_stats is None
+                            else int(final_page_stats["pages_total"])),
+            kv_pages_peak=pages_peak,
+            kv_page_utilization=page_util)

@@ -38,7 +38,13 @@ _GenCfg = collections.namedtuple(
     "_GenCfg",
     "n_layer n_head n_embd n_positions dtype layer_norm_epsilon "
     "use_flash_decode sparse_block sparse_num_local sparse_num_global "
-    "sparse_threshold", defaults=(False, 0, 0, 0, 0))
+    "sparse_threshold kv_page_len", defaults=(False, 0, 0, 0, 0, 0))
+# kv_page_len is the PAGED cache-spec variant (adapters declare it via
+# ModelAdapter.cache_spec when the engine serves a paged pool): > 0
+# names the page quantum the pool and the block-table kernels share;
+# 0 (the default) keeps every existing construction dense. _forward
+# itself dispatches data-driven on the cache's ``block_tbl`` key — the
+# cfg field exists so the static-arg cache key changes with paging.
 # The sparse_* tail (defaults keep every existing construction dense and
 # bit-identical): when sparse_threshold > 0, einsum-path attention for
 # query positions >= the threshold is restricted to the block-sparse
@@ -146,9 +152,29 @@ def _forward(params, cfg, ids, cache, last_only=False):
     B, S = ids.shape
     nh, hd = cfg.n_head, cfg.n_embd // cfg.n_head
     pos = cache["pos"]                                 # [B] row frontiers
-    max_len = cache["k"].shape[3]
     int8 = cache["k"].dtype == jnp.int8
     has_prefix = "pk" in cache
+    # PAGED dispatch (inference/kv_pool.py paged layout): a block table
+    # means k/v are a page ARENA [L, P, H, page_len, D] and row b's
+    # logical plane is the concatenation of its table's pages. Writes
+    # scatter through the table; reads gather through it (or hand the
+    # table to the paged flash kernel). The gathered logical plane is
+    # elementwise equal to what the dense pool holds at every valid
+    # position — trash/unwritten pages are finite garbage the causal
+    # mask zeroes exactly — so streams stay bit-identical to dense.
+    paged = "block_tbl" in cache
+    if paged:
+        assert not has_prefix, "paged pools share prefixes via pages"
+        tbl = cache["block_tbl"]                       # [B, n_lp]
+        page_len = cache["k"].shape[3]
+        n_lp = tbl.shape[1]
+        max_len = n_lp * page_len                      # logical plane len
+        w_pos = pos[:, None] + jnp.arange(S)[None]     # [B, S]
+        w_pg = tbl[jnp.arange(B)[:, None],
+                   jnp.minimum(w_pos // page_len, n_lp - 1)]
+        w_off = w_pos % page_len
+    else:
+        max_len = cache["k"].shape[3]
 
     eps = cfg.layer_norm_epsilon
     wte = params["wte"].astype(cfg.dtype)
@@ -159,8 +185,15 @@ def _forward(params, cfg, ids, cache, last_only=False):
     # Flash-decode engages when the flag is on AND the cache plane length
     # fits the kernel's block quantum (kv_pool pads its pool; ad-hoc
     # caches of other lengths take the einsum path below — same math).
-    use_flash = cfg.use_flash_decode and \
-        decode_attention.decode_supported(max_len)
+    # Paged pools key on PAGE length instead: kernel blocks == pages, so
+    # the paged kernel engages when one page is a whole block quantum;
+    # smaller pages (CPU-test geometries) gather + einsum below.
+    if paged:
+        use_flash = cfg.use_flash_decode and \
+            decode_attention.decode_supported(page_len)
+    else:
+        use_flash = cfg.use_flash_decode and \
+            decode_attention.decode_supported(max_len)
     sparse_thr = getattr(cfg, "sparse_threshold", 0)
     if sparse_thr and use_flash:
         raise ValueError(
@@ -207,16 +240,38 @@ def _forward(params, cfg, ids, cache, last_only=False):
             pad[2] = (0, max_len - p.shape[2])
             return jnp.pad(p, pad)
 
-    def write_rows(cache_l, new):
-        # [B, H, T, D] cache plane <- [B, H, S, D] at each row's frontier
-        # (vmapped dynamic_update_slice lowers to one scatter).
-        return jax.vmap(lambda c, n, p: jax.lax.dynamic_update_slice(
-            c, n, (0, p, 0)))(cache_l, new, pos)
+    if paged:
+        def write_rows(arena_l, new):
+            # Page arena [P, H, page_len, D] <- [B, H, S, D] scattered
+            # at (page, offset) through the block table. Distinct live
+            # positions map to distinct (page, offset) pairs (the table
+            # is injective per row outside the trash page), so the
+            # scatter is collision-free wherever it is ever read.
+            return arena_l.at[w_pg, :, w_off, :].set(
+                new.transpose(0, 2, 1, 3))
 
-    def write_scale_rows(cache_l, new):
-        # [B, H, T] scale plane <- [B, H, S] at each row's frontier.
-        return jax.vmap(lambda c, n, p: jax.lax.dynamic_update_slice(
-            c, n, (0, p)))(cache_l, new, pos)
+        def write_scale_rows(arena_l, new):
+            # Scale arena [P, H, page_len] <- [B, H, S] likewise.
+            return arena_l.at[w_pg, :, w_off].set(new.transpose(0, 2, 1))
+
+        def gather_pages(arena_l):
+            # [P, H, page_len, ...] -> row-major logical planes
+            # [B, H, n_lp * page_len, ...] via one table gather.
+            g = jnp.take(arena_l, tbl, axis=0)         # [B, n_lp, H, p, ...]
+            g = jnp.moveaxis(g, 2, 1)                  # [B, H, n_lp, p, ...]
+            return g.reshape((B, nh, max_len) + g.shape[4:])
+    else:
+        def write_rows(cache_l, new):
+            # [B, H, T, D] cache plane <- [B, H, S, D] at each row's
+            # frontier (vmapped dynamic_update_slice lowers to one
+            # scatter).
+            return jax.vmap(lambda c, n, p: jax.lax.dynamic_update_slice(
+                c, n, (0, p, 0)))(cache_l, new, pos)
+
+        def write_scale_rows(cache_l, new):
+            # [B, H, T] scale plane <- [B, H, S] at each row's frontier.
+            return jax.vmap(lambda c, n, p: jax.lax.dynamic_update_slice(
+                c, n, (0, p)))(cache_l, new, pos)
 
     for i in range(cfg.n_layer):
         blk = params["h_{}".format(i)]
@@ -238,10 +293,19 @@ def _forward(params, cfg, ids, cache, last_only=False):
             v_cache = v_cache.at[i].set(write_rows(v_cache[i], v))
         # Effective planes: the row's own just-written plane, with the
         # aliased prefix selected in below pbase[b] (codes AND scales —
-        # both tiers compose).
-        k_eff, v_eff = k_cache[i], v_cache[i]
-        if int8:
-            ks_eff, vs_eff = ks_cache[i], vs_cache[i]
+        # both tiers compose). Paged rows GATHER their logical plane
+        # through the block table AFTER the write (the einsum/reference
+        # path; the paged flash kernel gathers in its own index map and
+        # skips this materialization).
+        if paged and not use_flash:
+            k_eff, v_eff = gather_pages(k_cache[i]), gather_pages(v_cache[i])
+            if int8:
+                ks_eff = gather_pages(ks_cache[i])
+                vs_eff = gather_pages(vs_cache[i])
+        else:
+            k_eff, v_eff = k_cache[i], v_cache[i]
+            if int8:
+                ks_eff, vs_eff = ks_cache[i], vs_cache[i]
         if has_prefix:
             k_eff = jnp.where(psel, pad_prefix(cache["pk"][i]), k_eff)
             v_eff = jnp.where(psel, pad_prefix(cache["pv"][i]), v_eff)
@@ -256,7 +320,20 @@ def _forward(params, cfg, ids, cache, last_only=False):
             # cache was just written, so pos is the PRE-write frontier
             # the kernel's mask convention expects. The q8 family
             # dequantizes in-block from codes + scales.
-            if int8:
+            if paged:
+                # Block-table flash decode: the kernel's scalar-prefetch
+                # index map resolves (row, block j) -> arena page, so
+                # pages stream into VMEM straight from the table with
+                # the same straddle-only masking as the dense kernel.
+                if int8:
+                    y = decode_attention.flash_decode_attention_paged_q8(
+                        q, k_eff, v_eff, ks_eff, vs_eff, tbl, pos,
+                        scale=1.0 / float(hd) ** 0.5)
+                else:
+                    y = decode_attention.flash_decode_attention_paged(
+                        q, k_eff, v_eff, tbl, pos,
+                        scale=1.0 / float(hd) ** 0.5)
+            elif int8:
                 y = decode_attention.flash_decode_attention_q8(
                     q, k_eff, v_eff, ks_eff, vs_eff, pos,
                     scale=1.0 / float(hd) ** 0.5)

@@ -657,3 +657,287 @@ def flash_decode_attention_q8(q, k, v, k_scale, v_scale, pos, scale=None,
             q, k, v, k_scale, v_scale, pos)
     return _flash_decode_q8_pallas(q, k, v, k_scale, v_scale, pos,
                                    float(scale), int(bk))
+
+
+# ---------------------------------------------------------------------------
+# Paged kernels (families "decode_attention_paged[_q8]") — block-table
+# flash decode over the paged KV pool's page ARENA (inference/kv_pool.py
+# paged layout). The arena is [P, H, page_len, D] per layer and each row's
+# logical plane is named by an int32 block table [B, n_lp]: logical block
+# j of row b lives in arena page ``tbl[b, j]``. KERNEL BLOCKS == PAGES:
+# block_k is page_len, so the only new machinery is the kv index map —
+# it rides a second scalar-prefetch operand (the table) and resolves
+# (b, j) -> arena page, with the SAME past-frontier clamp (a repeated
+# page index issues no new DMA) and the same straddle-only masking; the
+# kernel bodies are the dense bodies unchanged (global key positions are
+# j * page_len + lane, exactly as dense).
+# ---------------------------------------------------------------------------
+
+def _decode_kernel_paged(pos_ref, tbl_ref, *rest, **kw):
+    # The table is consumed ENTIRELY by the index maps; the body math is
+    # the dense kernel's.
+    return _decode_kernel(pos_ref, *rest, **kw)
+
+
+def _decode_kernel_paged_q8(pos_ref, tbl_ref, *rest, **kw):
+    return _decode_kernel_q8(pos_ref, *rest, **kw)
+
+
+@hot_path
+def decode_attention_paged_reference(q, k, v, block_tbl, pos, scale=None):
+    """Paged ground truth: gather each row's pages into its dense
+    logical plane, then the dense reference — the same math the engine's
+    einsum (flag-off) path computes, so kernel-on and kernel-off paged
+    serving agree bit-for-bit.
+
+    q: [B, H, S, D]; k, v: [P, H, page_len, D] page arenas;
+    block_tbl: [B, n_lp] int32; pos: [B] int32 frontiers."""
+    B, H = q.shape[0], q.shape[1]
+    page_len = k.shape[2]
+    T = block_tbl.shape[1] * page_len
+
+    def gather(arena):
+        g = jnp.take(arena, block_tbl, axis=0)     # [B, n_lp, H, p, ...]
+        g = jnp.moveaxis(g, 2, 1)                  # [B, H, n_lp, p, ...]
+        return g.reshape((B, H, T) + g.shape[4:])
+
+    return decode_attention_reference(q, gather(k), gather(v), pos,
+                                      scale=scale)
+
+
+@hot_path
+def decode_attention_paged_q8_reference(q, k, v, k_scale, v_scale,
+                                        block_tbl, pos, scale=None):
+    """int8 paged ground truth: gather codes AND scales through the
+    table, dequantize, then the dense reference."""
+    B, H = q.shape[0], q.shape[1]
+    page_len = k.shape[2]
+    T = block_tbl.shape[1] * page_len
+
+    def gather(arena):
+        g = jnp.take(arena, block_tbl, axis=0)
+        g = jnp.moveaxis(g, 2, 1)
+        return g.reshape((B, H, T) + g.shape[4:])
+
+    kf = dequantize_kv(gather(k), gather(k_scale), q.dtype)
+    vf = dequantize_kv(gather(v), gather(v_scale), q.dtype)
+    return decode_attention_reference(q, kf, vf, pos, scale=scale)
+
+
+def _flash_decode_paged_pallas(q, k, v, tbl, pos, scale):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    page_len = k.shape[2]
+    n_lp = tbl.shape[1]
+    q = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    pos = pos.astype(jnp.int32)
+    tbl = tbl.astype(jnp.int32)
+    sub = _sublane(q.dtype)
+    s_blk = -(-s // sub) * sub
+    if s_blk != s:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, s_blk - s), (0, 0)))
+
+    def kv_index(b_, h_, j, pos_ref, tbl_ref):
+        # Logical block j of row b_ lives in arena page tbl[b_, j];
+        # past-frontier blocks clamp to the last useful LOGICAL block
+        # first, so the resolved PAGE repeats and issues no new DMA.
+        last = (pos_ref[b_] + s - 1) // page_len
+        return (tbl_ref[b_, jnp.minimum(j, last)], h_, 0, 0)
+
+    def q_index(b_, h_, j, pos_ref, tbl_ref):
+        return (b_, h_, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, n_lp),
+        in_specs=[
+            pl.BlockSpec((1, 1, s_blk, d), q_index),
+            pl.BlockSpec((1, 1, page_len, d), kv_index),
+            pl.BlockSpec((1, 1, page_len, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, s_blk, d), q_index),
+        scratch_shapes=[] if n_lp == 1 else [
+            pltpu.VMEM((s_blk, d), jnp.float32),
+            pltpu.VMEM((s_blk, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((s_blk, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel_paged, s_len=s, block_k=page_len,
+                          single_kv=n_lp == 1),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, s_blk, d), q.dtype),
+        interpret=_interpret(),
+    )(pos, tbl, q, k, v)
+    return out[:, :, :s] if s_blk != s else out
+
+
+def _flash_decode_paged_q8_pallas(q, k, v, k_scale, v_scale, tbl, pos,
+                                  scale):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    page_len = k.shape[2]
+    n_lp = tbl.shape[1]
+    q = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    pos = pos.astype(jnp.int32)
+    tbl = tbl.astype(jnp.int32)
+    k_scale = k_scale.astype(jnp.float32)[..., None]
+    v_scale = v_scale.astype(jnp.float32)[..., None]
+    sub = _sublane(q.dtype)
+    s_blk = -(-s // sub) * sub
+    if s_blk != s:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, s_blk - s), (0, 0)))
+
+    def kv_index(b_, h_, j, pos_ref, tbl_ref):
+        last = (pos_ref[b_] + s - 1) // page_len
+        return (tbl_ref[b_, jnp.minimum(j, last)], h_, 0, 0)
+
+    def q_index(b_, h_, j, pos_ref, tbl_ref):
+        return (b_, h_, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, n_lp),
+        in_specs=[
+            pl.BlockSpec((1, 1, s_blk, d), q_index),
+            pl.BlockSpec((1, 1, page_len, d), kv_index),
+            pl.BlockSpec((1, 1, page_len, d), kv_index),
+            pl.BlockSpec((1, 1, page_len, 1), kv_index),
+            pl.BlockSpec((1, 1, page_len, 1), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, s_blk, d), q_index),
+        scratch_shapes=[] if n_lp == 1 else [
+            pltpu.VMEM((s_blk, d), jnp.float32),
+            pltpu.VMEM((s_blk, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((s_blk, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel_paged_q8, s_len=s,
+                          block_k=page_len, single_kv=n_lp == 1),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, s_blk, d), q.dtype),
+        interpret=_interpret(),
+    )(pos, tbl, q, k, v, k_scale, v_scale)
+    return out[:, :, :s] if s_blk != s else out
+
+
+@functools.lru_cache(maxsize=None)
+def _decode_paged_partitioned(scale):
+    def f(q, k, v, tbl, pos):
+        return _flash_decode_paged_pallas(q, k, v, tbl, pos, scale)
+
+    cp = custom_partitioning(f)
+
+    def shardings(mesh, q_sharding):
+        b, h = _bh_spec(q_sharding)
+        full = NamedSharding(mesh, P(b, h, None, None))
+        # The arena's page dim replicates (every shard must reach every
+        # page — the table is data, not layout); heads shard like q.
+        arena = NamedSharding(mesh, P(None, h, None, None))
+        tbl_sh = NamedSharding(mesh, P(b, None))
+        pos_sh = NamedSharding(mesh, P(b))
+        return (full, arena, arena, tbl_sh, pos_sh), (full,)
+
+    def infer(mesh, arg_shapes, shape):
+        return shardings(mesh, arg_shapes[0].sharding)[1][0]
+
+    def partition(mesh, arg_shapes, result_shape):
+        args, outs = shardings(mesh, arg_shapes[0].sharding)
+        return mesh, f, outs[0], args
+
+    # Factors ordered by first appearance: t, d (q), p, s (arena),
+    # n (table).
+    _def_partition(cp, partition, infer,
+                   "b h t d, p h s d, p h s d, b n, b -> b h t d",
+                   ("t", "d", "p", "s", "n"))
+    return cp
+
+
+@functools.lru_cache(maxsize=None)
+def _decode_paged_q8_partitioned(scale):
+    def f(q, k, v, k_scale, v_scale, tbl, pos):
+        return _flash_decode_paged_q8_pallas(q, k, v, k_scale, v_scale,
+                                             tbl, pos, scale)
+
+    cp = custom_partitioning(f)
+
+    def shardings(mesh, q_sharding):
+        b, h = _bh_spec(q_sharding)
+        full = NamedSharding(mesh, P(b, h, None, None))
+        arena = NamedSharding(mesh, P(None, h, None, None))
+        sc = NamedSharding(mesh, P(None, h, None))
+        tbl_sh = NamedSharding(mesh, P(b, None))
+        pos_sh = NamedSharding(mesh, P(b))
+        return (full, arena, arena, sc, sc, tbl_sh, pos_sh), (full,)
+
+    def infer(mesh, arg_shapes, shape):
+        return shardings(mesh, arg_shapes[0].sharding)[1][0]
+
+    def partition(mesh, arg_shapes, result_shape):
+        args, outs = shardings(mesh, arg_shapes[0].sharding)
+        return mesh, f, outs[0], args
+
+    _def_partition(
+        cp, partition, infer,
+        "b h t d, p h s d, p h s d, p h s, p h s, b n, b -> b h t d",
+        ("t", "d", "p", "s", "n"))
+    return cp
+
+
+@hot_path
+def flash_decode_attention_paged(q, k, v, block_tbl, pos, scale=None):
+    """Block-table flash decode over a page arena.
+
+    Args:
+      q: [B, H, S, D] query rows at per-row frontiers ``pos``; each
+        row's k/v for those positions must already be SCATTERED into
+        its pages (models/generation.py writes before attending).
+      k, v: [P, H, page_len, D] page arenas (one layer's view of the
+        paged pool; page 0 is the trash page freed rows point at).
+      block_tbl: [B, n_lp] int32 — row b's logical block j lives in
+        arena page ``block_tbl[b, j]``.
+      pos: [B] int32 per-row frontiers.
+      scale: score scale; default 1/sqrt(D).
+
+    block_k is page_len by construction (kernel blocks == pages), so
+    there is no autotuned tile here; page_len must be a multiple of
+    BLOCK_MIN for the kernel to engage, and other page sizes take the
+    gather + dense-reference fallback (same math).
+    Returns: [B, H, S, D] in q.dtype.
+    """
+    d = q.shape[-1]
+    page_len = k.shape[2]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if not decode_supported(page_len):
+        return decode_attention_paged_reference(q, k, v, block_tbl, pos,
+                                                scale=scale)
+    if _use_custom_partitioning():
+        return _decode_paged_partitioned(float(scale))(
+            q, k, v, block_tbl, pos)
+    return _flash_decode_paged_pallas(q, k, v, block_tbl, pos,
+                                      float(scale))
+
+
+@hot_path
+def flash_decode_attention_paged_q8(q, k, v, k_scale, v_scale, block_tbl,
+                                    pos, scale=None):
+    """int8 block-table flash decode: ``flash_decode_attention_paged``
+    over int8 code arenas with fp32 per-(head, position) scale arenas
+    [P, H, page_len], dequantizing in-block exactly like the dense q8
+    family."""
+    d = q.shape[-1]
+    page_len = k.shape[2]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if not decode_supported(page_len):
+        return decode_attention_paged_q8_reference(
+            q, k, v, k_scale, v_scale, block_tbl, pos, scale=scale)
+    if _use_custom_partitioning():
+        return _decode_paged_q8_partitioned(float(scale))(
+            q, k, v, k_scale, v_scale, block_tbl, pos)
+    return _flash_decode_paged_q8_pallas(q, k, v, k_scale, v_scale,
+                                         block_tbl, pos, float(scale))

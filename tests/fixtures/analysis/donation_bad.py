@@ -9,3 +9,9 @@ def advance(pool):
     out = step(pool)         # pool's buffers are donated here
     frontier = pool["pos"]   # ...so this reads a dead array
     return out, frontier
+
+
+def rebind_from_dead(pool):
+    out = step(pool)          # donated, never rebound...
+    pool = dict(pool, x=1)    # ...so this rebind-read sees a dead array
+    return out, pool

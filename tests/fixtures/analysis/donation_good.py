@@ -15,3 +15,9 @@ def advance_twice(pool):
     new_pool = step(pool)    # old name never read again before rebind
     pool = new_pool
     return step(pool)
+
+
+def rebind_table(pool, table):
+    pool = step(pool)         # rebound by the donating statement...
+    pool = dict(pool, t=table)  # ...so this composite rebind reads LIVE
+    return pool

@@ -409,7 +409,7 @@ def test_disagg_itl_p99_beats_mixed_at_same_rate():
     assert on_res.handoffs == 24 and on_res.handoff_fallbacks == 0
     assert on_res.handoff_bytes_shipped > 0
     assert off_res.handoffs == 0
-    assert on_rep["schema_version"] == 6
+    assert on_rep["schema_version"] == 7
     assert on_rep["disagg"] == {
         "handoffs": 24, "handoff_fallbacks": 0,
         "handoff_bytes_shipped": on_res.handoff_bytes_shipped}

@@ -512,7 +512,7 @@ def test_report_prefix_section_counts_hits_and_misses():
     assert res.prefix_hits > 0
     assert res.prefix_hits + res.prefix_misses >= 16
     rep = build_report(spec, res, SLO(ttft_p99_ms=1e4, itl_p99_ms=2e3))
-    assert rep["schema_version"] == 6
+    assert rep["schema_version"] == 7
     sec = rep["prefix"]
     assert sec["prefix_hits"] == res.prefix_hits
     assert sec["prefix_misses"] == res.prefix_misses
@@ -648,7 +648,7 @@ def test_bench_sustained_smoke_report():
     assert result["unit"] == "tokens/s/chip"
     assert result["value"] > 0
     rep = result["extra"]["sustained"]
-    assert rep["schema_version"] == 6
+    assert rep["schema_version"] == 7
     wins = rep["timeseries"]["windows"]
     carrying = [w for w in wins
                 if w["ttft_p99_ms"] is not None
@@ -722,7 +722,7 @@ def test_chaos_section_empty_on_fault_free_run():
     assert res.recovery == [] and res.requests_lost == 0
     assert res.faults_injected == 0
     rep = build_report(spec, res, SLO(ttft_p99_ms=1e4, itl_p99_ms=2e3))
-    assert rep["schema_version"] == 6
+    assert rep["schema_version"] == 7
     chaos = rep["chaos"]
     assert chaos["recoveries"] == 0 and chaos["recovery_time_s"] == 0.0
     assert chaos["requests_during_recovery"] == 0
@@ -749,7 +749,7 @@ def test_bench_chaos_smoke_report():
     assert extra["requests_lost"] == 0
     assert extra["recoveries"] >= 1 and extra["faults_injected"] >= 1
     rep = extra["chaos_report"]
-    assert rep["schema_version"] == 6
+    assert rep["schema_version"] == 7
     assert rep["chaos"]["requests_lost"] == 0
     assert rep["context"]["fault_plan"]["faults"][0]["kind"] == "raise"
 
