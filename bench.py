@@ -47,6 +47,54 @@ _PROBE_STATE = None
 # can tell a trusted claim from a measured one.
 ASSUME_TPU_ENV = "DS_TPU_BENCH_ASSUME_TPU"
 
+# Bench-harness MetricsRegistry (lazy: telemetry imports only when the
+# probe machinery actually runs). The probe diagnostics above were
+# JSON-only; promoting them to counters/gauges makes a wedged-probe
+# round visible on the SAME Prometheus plane as the serving metrics
+# (the exporter suffixes counters with _total):
+#   bench_probe_attempts_total{outcome=ok|error}  — every probe attempt
+#   bench_probe_state{state=...}                  — one-hot _PROBE_STATE
+#   bench_fallbacks_total{reason=...}             — CPU-fallback emits
+# The rendered text rides each artifact under extra.bench_prometheus.
+_BENCH_TELEMETRY = None
+
+# One-hot domain for bench_probe_state. "unprobed" mirrors
+# _PROBE_STATE=None; "gave_up" is telemetry-only — the global stays
+# None on failure (a wedge can clear; failures are never cached), but
+# the gauge must still say the probe ran out of budget.
+_PROBE_STATE_DOMAIN = ("unprobed", "probed", "cached", "skipped",
+                       "gave_up")
+
+
+def _bench_telemetry():
+    global _BENCH_TELEMETRY
+    if _BENCH_TELEMETRY is None:
+        from deepspeed_tpu.telemetry import MetricsRegistry
+
+        _BENCH_TELEMETRY = MetricsRegistry()
+    return _BENCH_TELEMETRY
+
+
+def _note_probe_state(state):
+    """Mirror a probe-state transition into the one-hot gauge. Telemetry
+    is best-effort — the bench must never die on its own diagnostics."""
+    try:
+        reg = _bench_telemetry()
+        for s in _PROBE_STATE_DOMAIN:
+            reg.gauge("bench_probe_state", state=s).set(
+                1.0 if s == (state or "unprobed") else 0.0)
+    except Exception:
+        pass
+
+
+def _note_probe_attempt(ok):
+    try:
+        _bench_telemetry().counter(
+            "bench_probe_attempts",
+            outcome="ok" if ok else "error").inc()
+    except Exception:
+        pass
+
 
 def _git_state():
     """Short commit hash of the measured code, '-dirty'-suffixed when the
@@ -119,12 +167,14 @@ def _device_probe(budget=480, attempt_timeout=None, probe=_probe_once,
     global _PROBE_STATE
     if os.environ.get(ASSUME_TPU_ENV, "0") not in ("0", "", "false"):
         _PROBE_STATE = "skipped"
+        _note_probe_state("skipped")
         return True
     if os.environ.get("JAX_PLATFORMS", "") == "cpu" or \
             not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return True
     if _PROBE_STATE in ("probed", "cached"):
         _PROBE_STATE = "cached"
+        _note_probe_state("cached")
         return True
     env_t = os.environ.get("DS_TPU_BENCH_PROBE_TIMEOUT")
     if attempt_timeout is not None:
@@ -144,11 +194,13 @@ def _device_probe(budget=480, attempt_timeout=None, probe=_probe_once,
         if remaining <= 0 or (max_attempts and attempt > max_attempts):
             print("bench: giving up on accelerator after {} attempts / "
                   "{}s budget".format(attempt - 1, budget), file=sys.stderr)
+            _note_probe_state("gave_up")
             return False
         t = min(first_timeout if attempt == 1 else later_timeout,
                 max(30, remaining))
         t_start = time.time()
         ok, reason = probe(t)
+        _note_probe_attempt(ok)
         _PROBE_ATTEMPTS.append({
             "attempt": attempt,
             "timeout_s": round(t, 1),
@@ -157,6 +209,7 @@ def _device_probe(budget=480, attempt_timeout=None, probe=_probe_once,
         })
         if ok:
             _PROBE_STATE = "probed"
+            _note_probe_state("probed")
             return True
         print("bench: accelerator probe attempt {} failed ({})".format(
             attempt, reason), file=sys.stderr)
@@ -164,6 +217,7 @@ def _device_probe(budget=480, attempt_timeout=None, probe=_probe_once,
                 (max_attempts and attempt >= max_attempts):
             print("bench: giving up on accelerator after {} attempts / "
                   "{}s budget".format(attempt, budget), file=sys.stderr)
+            _note_probe_state("gave_up")
             return False
         print("bench: retrying in {}s".format(backoff), file=sys.stderr)
         sleep(backoff)
@@ -397,6 +451,11 @@ def _emit(result):
     fallback = os.environ.get("DS_BENCH_FALLBACK")
     if fallback:
         result["extra"]["fallback"] = fallback
+        try:
+            _bench_telemetry().counter("bench_fallbacks",
+                                       reason=fallback).inc()
+        except Exception:
+            pass
         # Machine-readable marker that THIS line was measured on the CPU
         # fallback path (previously only a stderr log line said so —
         # drivers parsing the JSON could mistake the smoke number for an
@@ -464,6 +523,16 @@ def _emit(result):
     # per recorder site, ring drops, and any SLO alerts that fired.
     if _TRACE_SUMMARY is not None:
         result["extra"].setdefault("trace_summary", dict(_TRACE_SUMMARY))
+    # Bench-harness telemetry (probe attempts/state, fallback counts) in
+    # Prometheus text form — only when the probe machinery actually ran
+    # and created the registry; the common CPU/tier-1 path skips it.
+    if _BENCH_TELEMETRY is not None:
+        try:
+            from deepspeed_tpu.telemetry import prometheus_text
+            result["extra"].setdefault(
+                "bench_prometheus", prometheus_text(_BENCH_TELEMETRY))
+        except Exception:
+            pass
     # flush: under the battery/supervisor stdout is a file; a later wedge
     # must not take this already-earned result line with it.
     print(json.dumps(result), flush=True)
@@ -1085,6 +1154,11 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
     # describes exactly the timed stream.
     m = engine.metrics(reset=True)
     telemetry = engine.telemetry_snapshot()
+    # Perf X-ray export (telemetry/xray.py): per-program XLA cost/memory
+    # analysis + roofline/HBM ledger. Materialization AOT-compiles the
+    # non-dispatched programs, so it happens HERE — after the measured
+    # window closed, before the sequential baseline is timed.
+    perf_xray = engine.perf_xray()
     profile_dir = os.environ.get(PROFILE_DIR_ENV)
     if profile_dir:
         # The profiler capture landed under profile_dir via
@@ -1218,6 +1292,7 @@ def _measure_serving(smoke=False, flash_decode=None, chunked_prefill=True,
             "decode_ms_per_token": round(
                 decode_s / max(decode_steps, 1) * 1e3, 4),
             "telemetry": telemetry,
+            "perf_xray": perf_xray,
         },
     }
 
@@ -1357,6 +1432,12 @@ def _measure_sustained(smoke=False):
     report["saturation"] = saturation_sweep(
         sweep_step, sweep_rates,
         attainment_floor=0.95 if on_tpu else 0.5)
+    # Perf X-ray section: per-program cost/memory model for THIS report's
+    # engine — the regression gate compares two reports' cost models
+    # without hardware (a bytes/token increase flags on CPU). Stamped
+    # BEFORE the A/A self-check so the self-check exercises the
+    # cost-model gate too.
+    report["perf_xray"] = engine.perf_xray()
     # A/A self-check: the gate against the report itself must pass (delta
     # is exactly 0 everywhere) — stamped so every report proves its own
     # gate is not trivially red.

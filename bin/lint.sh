@@ -20,6 +20,9 @@ python -c "import sys; \
 from deepspeed_tpu.telemetry.distributed import _self_check; \
 sys.exit(_self_check())"
 
+echo "== perf x-ray =="
+python -m deepspeed_tpu.telemetry.xray --self-check
+
 echo "== compileall =="
 python -m compileall -q deepspeed_tpu
 

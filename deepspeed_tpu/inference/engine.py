@@ -127,8 +127,10 @@ from deepspeed_tpu.inference.adapters import GPT2Adapter
 from deepspeed_tpu.inference.scheduler import QueueFull, Scheduler
 from deepspeed_tpu.parallel import mesh as mesh_lib
 from deepspeed_tpu.telemetry import (
+    HBMLedger,
     MetricsRegistry,
     NullRecorder,
+    ProgramRegistry,
     RecompileDetector,
     SpanRecorder,
     annotate,
@@ -613,11 +615,28 @@ class InferenceEngine(object):
             functools.partial(_mixed_step_program), static_argnums=(1, 2, 3),
             donate_argnums=(4,), out_shardings=mixed_out)
 
+        # Perf X-ray (telemetry/xray.py): the compiled-program cost/
+        # memory observatory. Step paths stash shape signatures only
+        # (no device touch); export paths — perf_xray(), bench — pay
+        # the one-time AOT lower+compile, which never touches a jit
+        # wrapper's dispatch cache and so cannot read as a recompile.
+        self._xray = None
+        self._ledger = None
+        if config.perf_xray:
+            self._xray = ProgramRegistry(
+                self.telemetry, platform=jax.default_backend(),
+                sample_every=config.xray_sample_every)
+
         # Recompile detection: the test-only compile_count contract as a
         # RUNTIME gauge. The mixed program auto-warms after its first
         # step; the legacy path warms per exercised bucket, so the
-        # caller (bench's A/B warmup) calls mark_warm() explicitly.
-        self.recompile_detector = RecompileDetector(self.telemetry)
+        # caller (bench's A/B warmup) calls mark_warm() explicitly. The
+        # xray identity hook makes the post-warm warning name the exact
+        # program (HLO fingerprint, old -> new shapes).
+        self.recompile_detector = RecompileDetector(
+            self.telemetry,
+            describe=self._xray.identity if self._xray is not None
+            else None)
         self.recompile_detector.watch("prefill", self._prefill)
         self.recompile_detector.watch("decode_chunk", self._decode)
         self.recompile_detector.watch("mixed_step", self._mixed)
@@ -696,6 +715,21 @@ class InferenceEngine(object):
         # the one HBM number the paged-vs-dense capacity pin compares.
         self.telemetry.gauge("kv_hbm_bytes").set_fn(
             lambda: pool_nbytes(self._pool))
+        if self._xray is not None:
+            # HBM ledger: predicted (params + KV arena + largest
+            # program temp) vs live device.memory_stats() where the
+            # backend has it. program_temp reads 0 until the first
+            # xray export materializes — a scrape must never compile.
+            self._ledger = HBMLedger(
+                self.telemetry, capacity_bytes=config.hbm_budget_bytes)
+            params_bytes = sum(
+                int(getattr(leaf, "nbytes", 0))
+                for leaf in jax.tree_util.tree_leaves(self._params))
+            self._ledger.set_component("params", params_bytes)
+            self._ledger.set_component(
+                "kv_arena", lambda: pool_nbytes(self._pool))
+            self._ledger.set_component(
+                "program_temp", self._xray.max_temp_bytes)
         if self._pager is not None:
             pg = self._pager
             self.telemetry.gauge("kv_pages_in_use").set_fn(pg.pages_in_use)
@@ -1100,12 +1134,25 @@ class InferenceEngine(object):
         bucket = self.config.bucket_for(req.prompt.size)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :req.prompt.size] = req.prompt
+        padded_d = jnp.asarray(padded)
+        n_d, slot_d = jnp.int32(req.prompt.size), jnp.int32(slot)
+        max_new_d = jnp.int32(req.max_new_tokens)
+        eos_d = jnp.int32(req.eos_token_id)
+        temp_d = jnp.float32(req.temperature)
+        top_k_d, seed_d = jnp.int32(req.top_k), jnp.uint32(req.seed)
+        if self._xray is not None:
+            # One stash per exercised bucket (bucket variety is the
+            # legacy path's EXPECTED compile shape, so only post-warm
+            # changes are tracked as recompiles).
+            self._xray.stash(
+                "prefill", self._prefill, self._params, self._adapter,
+                self._pool, padded_d, n_d, slot_d, max_new_d, eos_d,
+                temp_d, top_k_d, seed_d, donate=("pool",),
+                track_change=self.recompile_detector.warm)
+            self._xray.note("prefill", tokens=1)
         self._pool, first = self._prefill(
-            self._params, self._adapter, self._pool, jnp.asarray(padded),
-            jnp.int32(req.prompt.size), jnp.int32(slot),
-            jnp.int32(req.max_new_tokens), jnp.int32(req.eos_token_id),
-            jnp.float32(req.temperature), jnp.int32(req.top_k),
-            jnp.uint32(req.seed))
+            self._params, self._adapter, self._pool, padded_d,
+            n_d, slot_d, max_new_d, eos_d, temp_d, top_k_d, seed_d)
         self.counters["prefills"] += 1
         self.counters["prefill_tokens"] += int(req.prompt.size)
         return first
@@ -1800,15 +1847,44 @@ class InferenceEngine(object):
             # real XlaRuntimeError out of the call below.
             self._injector.maybe_raise()
         self.timers("inference/decode").start()
+        # Device scalars built before the call so the xray stash sees
+        # the exact argument structure the program is dispatched with.
+        ids_d = jnp.asarray(ids)
+        slot_d, frontier_d = jnp.int32(slot), jnp.int32(frontier)
+        n_valid_d, p_done_d = jnp.int32(n_valid), jnp.asarray(p_done)
+        p_spec_d, max_new_d = jnp.asarray(p_spec), jnp.int32(max_new)
+        eos_d, temp_d = jnp.int32(eos), jnp.float32(temp)
+        top_k_d, seed_d = jnp.int32(top_k), jnp.uint32(seed)
+        if self._xray is not None:
+            # Shapes-only capture (signature tuple + dict compare in
+            # the steady state). track_change only after warmup so the
+            # legacy of per-bucket variety never logs as a recompile.
+            self._xray.stash(
+                "mixed_step", self._mixed, self._params, self._adapter,
+                self.config.chunk_size, self._spec, self._pool, ids_d,
+                slot_d, frontier_d, n_valid_d, p_done_d, p_spec_d,
+                max_new_d, eos_d, temp_d, top_k_d, seed_d,
+                donate=("pool",),
+                track_change=self.recompile_detector.warm)
+        tok_before = self.counters["tokens_out"]
+        t_dispatch0 = time.perf_counter()
         with self.tracer.timed("step/mixed", prefill_tokens=n_valid), \
                 self._annotate("inference/mixed_step"):
             self._pool, first, toks, valid = self._mixed(
                 self._params, self._adapter, self.config.chunk_size,
                 self._spec,
-                self._pool, jnp.asarray(ids), jnp.int32(slot),
-                jnp.int32(frontier), jnp.int32(n_valid), jnp.asarray(p_done),
-                jnp.asarray(p_spec), jnp.int32(max_new), jnp.int32(eos),
-                jnp.float32(temp), jnp.int32(top_k), jnp.uint32(seed))
+                self._pool, ids_d, slot_d,
+                frontier_d, n_valid_d, p_done_d,
+                p_spec_d, max_new_d, eos_d,
+                temp_d, top_k_d, seed_d)
+        if self._xray is not None and self._xray.due():
+            # Sampled 1-in-N step decomposition: bracketed
+            # block_until_ready (sanctioned sync — xray.sample_step)
+            # splits host-schedule from device-compute time and feeds
+            # the roofline's measured step seconds.
+            self._xray.sample_step(
+                "mixed_step", (self._pool, first, toks, valid),
+                time.perf_counter() - t_dispatch0)
         # ONE batched host sync per step: tokens, validity, the per-slot
         # scalar snapshot (pos/active/last_tok in a single transfer) and
         # the (possible) first token all land together.
@@ -1894,6 +1970,12 @@ class InferenceEngine(object):
             # the handoff outbox in one batched capture. Requests that
             # COMPLETED this step already finished locally above.
             self._capture_handoffs()
+        if self._xray is not None:
+            # Per-program call/token accounting (two int adds): the
+            # flops-per-token and bytes-per-token denominators.
+            self._xray.note("mixed_step",
+                            tokens=self.counters["tokens_out"]
+                            - tok_before)
         self._observe_compiles()
         return done
 
@@ -1917,11 +1999,23 @@ class InferenceEngine(object):
 
         if self._scheduler.running:
             self.timers("inference/decode").start()
+            if self._xray is not None:
+                self._xray.stash(
+                    "decode_chunk", self._decode, self._params,
+                    self._adapter, self.config.chunk_size, self._pool,
+                    donate=("pool",),
+                    track_change=self.recompile_detector.warm)
+            tok_before = self.counters["tokens_out"]
+            t_dispatch0 = time.perf_counter()
             with self.tracer.timed("step/decode"), \
                     self._annotate("inference/decode_chunk"):
                 self._pool, toks, valid = self._decode(
                     self._params, self._adapter, self.config.chunk_size,
                     self._pool)
+            if self._xray is not None and self._xray.due():
+                self._xray.sample_step(
+                    "decode_chunk", (self._pool, toks, valid),
+                    time.perf_counter() - t_dispatch0)
             self.timers("inference/decode").stop()
             with self.tracer.timed("step/harvest"), \
                     self._annotate("inference/harvest"):
@@ -1943,6 +2037,10 @@ class InferenceEngine(object):
                 self.counters["tokens_out"] += len(emitted)
                 if not active[slot]:
                     self._complete(req, done)
+            if self._xray is not None:
+                self._xray.note("decode_chunk",
+                                tokens=self.counters["tokens_out"]
+                                - tok_before)
         self._observe_compiles()
         return done
 
@@ -2232,7 +2330,55 @@ class InferenceEngine(object):
             "spans_dropped": self.tracer.dropped,
             "compile_count": self.compile_count,
             "recompiles": int(self.recompile_detector.recompiles.value),
+            # Stashed-label count only — a snapshot must stay cheap,
+            # so it never materializes the observatory.
+            "xray_programs": (len(self._xray._programs)
+                              if self._xray is not None else 0),
         }
+
+    def _xray_stash_aux(self):
+        """AOT-observe the engine programs the current serving mode
+        never dispatches (chunked mode never calls prefill/decode;
+        legacy mode never calls mixed), so every export covers the
+        full program family. Shapes come from the live pool/config;
+        zero executions — cost model only."""
+        xr, cfg = self._xray, self.config
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        f32 = jax.ShapeDtypeStruct((), jnp.float32)
+        u32 = jax.ShapeDtypeStruct((), jnp.uint32)
+        b = jax.ShapeDtypeStruct((), jnp.bool_)
+        if not xr.seen("decode_chunk"):
+            xr.stash("decode_chunk", self._decode, self._params,
+                     self._adapter, cfg.chunk_size, self._pool,
+                     donate=("pool",))
+        if not xr.seen("prefill"):
+            padded = jax.ShapeDtypeStruct(
+                (1, cfg.prefill_buckets[0]), jnp.int32)
+            xr.stash("prefill", self._prefill, self._params,
+                     self._adapter, self._pool, padded, i32, i32, i32,
+                     i32, f32, i32, u32, donate=("pool",))
+        if not xr.seen("mixed_step") and cfg.chunked_prefill:
+            ids = jax.ShapeDtypeStruct((1, cfg.prefill_chunk), jnp.int32)
+            xr.stash("mixed_step", self._mixed, self._params,
+                     self._adapter, cfg.chunk_size, self._spec,
+                     self._pool, ids, i32, i32, i32, b, b, i32, i32,
+                     f32, i32, u32, donate=("pool",))
+
+    def perf_xray(self):
+        """The schema-versioned ``perf_xray`` artifact section
+        (telemetry/xray.py): per-program HLO fingerprints, cost-model
+        flops/bytes, the peak-HBM split, flops/bytes per token, the
+        HBM ledger, and any post-warm recompile events. First call
+        pays the one-time AOT lower+compile of each program (off the
+        steady path; never grows a jit dispatch cache). None when
+        ``config.perf_xray`` is off."""
+        if self._xray is None:
+            return None
+        self._xray_stash_aux()
+        out = self._xray.to_json()
+        if self._ledger is not None:
+            out["hbm"] = self._ledger.to_json()
+        return out
 
     def write_trace(self, path):
         """Dump the flight ring as a Chrome trace-event JSON file
@@ -2279,4 +2425,10 @@ class InferenceEngine(object):
         req = self.find_request(rid)
         if req is None:
             raise KeyError("unknown rid {}".format(rid))
-        return build_autopsy(self.trace_recorders(), req.trace.tid)
+        out = build_autopsy(self.trace_recorders(), req.trace.tid)
+        if self._xray is not None and self._xray.recompile_events:
+            # Post-warm recompiles, by the same identity key the
+            # RecompileDetector warning used: program label, old/new
+            # HLO fingerprint, old/new shape signature.
+            out["recompiled_programs"] = self._xray.recompile_dicts()
+        return out

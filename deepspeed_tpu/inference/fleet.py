@@ -1533,6 +1533,24 @@ class ServingFleet(object):
         agg["alerts_fired"] = len(self.alerts.fired())
         return {"fleet": agg, "replicas": per_replica}
 
+    def perf_xray(self):
+        """Per-replica ``perf_xray`` sections (engine.perf_xray()),
+        keyed by rid — the fleet face of the compiled-program
+        observatory. The roofline/HBM GAUGES already flow through the
+        merged registry with ``replica`` labels; this is the artifact-
+        shaped view bench and the regression gate consume. Replicas
+        with perf_xray off (or failed) contribute None."""
+        out = {}
+        for rep in self.replicas:
+            try:
+                out[rep.rid] = (rep.engine.perf_xray()
+                                if not rep.failed else None)
+            except Exception as e:
+                logger.warning("fleet: perf_xray on replica %d failed "
+                               "(%s)", rep.rid, e)
+                out[rep.rid] = None
+        return out
+
     def prefix_hit_rate(self):
         """Fleet-wide prefix hit rate (hits / probes, 0.0 when no
         probes) — the bench A/B's headline number."""

@@ -460,10 +460,25 @@ def regression_gate(baseline, candidate, metrics=None, rel_tol=0.10,
             row.update({"delta_rel": delta, "noise_floor": noise,
                         "threshold": thr, "flagged": bool(regress)})
         rows[m] = row
-    return {
+    out = {
         "pass": not any(r["flagged"] for r in rows.values()),
         "rel_tol": rel_tol,
         "noise_k": noise_k,
         "metrics": rows,
         "caveats": caveats,
     }
+    # Cost-model arm (telemetry/xray.py): when both reports carry a
+    # perf_xray section, compare the XLA cost models too — per-program
+    # flops / bytes-accessed / predicted peak HBM and the bytes-per-
+    # token total. These are COMPILER facts, not measurements: they are
+    # deterministic per (program, shapes), so a CPU-only A/B catches a
+    # "2x bytes per token" regression no timing series could resolve.
+    # A/A compares a report against itself and passes by construction.
+    xa, xb = baseline.get("perf_xray"), candidate.get("perf_xray")
+    if xa is not None and xb is not None:
+        from deepspeed_tpu.telemetry.xray import cost_model_gate
+
+        xgate = cost_model_gate(xa, xb)
+        out["perf_xray"] = xgate
+        out["pass"] = out["pass"] and bool(xgate.get("pass", True))
+    return out

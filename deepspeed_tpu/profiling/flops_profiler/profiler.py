@@ -6,11 +6,13 @@ to count MACs eagerly. Under XLA there is nothing to patch — the compiler
 already knows the cost of the compiled program. So the TPU-native profiler
 has two sources of truth:
 
-- **exact program cost**: ``observe(jitted_fn, *args)`` pulls
-  ``Compiled.cost_analysis()`` (flops, bytes accessed) from XLA for the real
-  training program the engine ran — this includes the backward pass and any
-  fusion effects, which the reference's functional-level MAC counting cannot
-  see;
+- **exact program cost**: ``observe(jitted_fn, *args)`` is a thin client of
+  the perf-xray ProgramRegistry (telemetry/xray.py — the one place that
+  does AOT lower+compile and reads ``Compiled.cost_analysis()``), so the
+  profiler's totals, the engine's roofline gauges, and bench's perf_xray
+  artifact section all come from the same records. The cost covers the real
+  training program the engine ran — backward pass and fusion effects
+  included, which the reference's functional-level MAC counting cannot see;
 - **per-module breakdown**: flax's interpreter-mode tabulation
   (``nn.Module.tabulate(compute_flops=True)``) walks the module tree and
   costs each submodule, replacing the hook machinery.
@@ -56,11 +58,20 @@ def duration_to_string(duration, precision=2):
 
 
 class FlopsProfiler(object):
-    """Profiles a flax model / jitted programs (reference profiler.py:11)."""
+    """Profiles a flax model / jitted programs (reference profiler.py:11).
 
-    def __init__(self, model=None):
+    ``xray`` is an optional shared telemetry.ProgramRegistry — the
+    training engine passes its own so profiled programs land in the
+    same observatory its perf_xray() exports; standalone use gets a
+    private, unpublished registry. Either way the per-(program, shape)
+    analysis is cached there: a profiled window pays one AOT compile
+    per program, not one per step."""
+
+    def __init__(self, model=None, xray=None):
         self.model = model
         self.started = False
+        self._xray = xray
+        self._labels = {}
         self.reset_profile()
 
     # ----------------------------------------------------------- lifecycle
@@ -72,7 +83,6 @@ class FlopsProfiler(object):
         self._duration = 0.0
         self._example_args = None
         self._example_kwargs = None
-        self._cost_cache = {}
 
     def start_profile(self, ignore_list=None):
         self.reset_profile()
@@ -91,26 +101,21 @@ class FlopsProfiler(object):
     def observe(self, jitted_fn, *args, **kwargs):
         """Record the XLA-compiled cost of one program invocation. The engine
         calls this with its fused fwd+bwd program, so totals reflect the real
-        executed flops (fwd+bwd+update), not an estimate."""
+        executed flops (fwd+bwd+update), not an estimate. Thin xray client:
+        the ProgramRegistry owns the AOT compile, the fingerprint, and the
+        per-(program, shapes) cache."""
         try:
-            # lower().compile() re-traces from scratch; cache per program so
-            # a profiled training window pays one AOT compile, not one per
-            # step.
-            shapes = tuple(
-                (getattr(x, "shape", None), str(getattr(x, "dtype", type(x))))
-                for x in jax.tree_util.tree_leaves((args, kwargs)))
-            key = (id(jitted_fn), shapes)
-            if key not in self._cost_cache:
-                compiled = jitted_fn.lower(*args, **kwargs).compile()
-                cost = compiled.cost_analysis()
-                if isinstance(cost, list):  # older jax returns [dict]
-                    cost = cost[0]
-                self._cost_cache[key] = (float(cost.get("flops", 0.0)),
-                                         float(cost.get("bytes accessed",
-                                                        0.0)))
-            flops, nbytes = self._cost_cache[key]
-            self._total_flops += flops
-            self._total_bytes += nbytes
+            if self._xray is None:
+                from deepspeed_tpu.telemetry import ProgramRegistry
+
+                self._xray = ProgramRegistry()
+            label = self._labels.setdefault(
+                id(jitted_fn),
+                getattr(jitted_fn, "__name__", None)
+                or "program{}".format(len(self._labels)))
+            record = self._xray.observe(label, jitted_fn, *args, **kwargs)
+            self._total_flops += record["flops"]
+            self._total_bytes += record["bytes_accessed"]
             self._observed += 1
         except Exception as e:  # cost analysis is best-effort
             logger.warning("flops observe failed: %s", e)

@@ -59,7 +59,8 @@ from deepspeed_tpu.runtime.utils import (
     jit_has_overflow,
 )
 from deepspeed_tpu.runtime.utils import global_norm as utils_global_norm
-from deepspeed_tpu.telemetry import MetricsRegistry, TensorBoardScalarWriter
+from deepspeed_tpu.telemetry import (MetricsRegistry, ProgramRegistry,
+                                     TensorBoardScalarWriter)
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 
@@ -224,6 +225,13 @@ class DeepSpeedEngine(object):
             lambda: self.skipped_steps)
         self.telemetry.gauge("lr").set_fn(
             lambda: (self.get_lr() if self.optimizer else [0.0])[0])
+        # Perf X-ray (telemetry/xray.py): train_batch's fused path
+        # stashes each compiled step program's shape signature here
+        # (microseconds; no compile). perf_xray() / the flops profiler
+        # materialize the cost/memory records on demand.
+        self.xray = ProgramRegistry(self.telemetry,
+                                    platform=jax.default_backend(),
+                                    sample_every=0)
 
         self.training_dataloader = self.deepspeed_io(training_data) \
             if training_data else None
@@ -2049,9 +2057,21 @@ class DeepSpeedEngine(object):
         self.tput_timer.start()
         group = self.optimizer.param_groups[0]
         beta1, beta2 = group.get("betas", (0.9, 0.999))
-        loss, self.params, self.opt_state = self._fused_step_cache[key](
-            self.params, self.opt_state, inputs, self._next_rng(),
-            jnp.float32(group["lr"]), jnp.float32(beta1), jnp.float32(beta2))
+        jitted = self._fused_step_cache[key]
+        rng = self._next_rng()
+        lr_d = jnp.float32(group["lr"])
+        b1_d, b2_d = jnp.float32(beta1), jnp.float32(beta2)
+        # Shapes-only xray capture of the exact fused program about to
+        # run (params/opt_state are donated — the stash abstracts
+        # leaves immediately and retains no buffer).
+        self.xray.stash("fused_train_step[{}]".format(key), jitted,
+                        self.params, self.opt_state, inputs, rng,
+                        lr_d, b1_d, b2_d,
+                        donate=("params", "opt_state"))
+        self.xray.note("fused_train_step[{}]".format(key),
+                       tokens=self.train_batch_size())
+        loss, self.params, self.opt_state = jitted(
+            self.params, self.opt_state, inputs, rng, lr_d, b1_d, b2_d)
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self.global_steps += 1
@@ -2077,7 +2097,10 @@ class DeepSpeedEngine(object):
 
     def _start_flops_profiler(self):
         from deepspeed_tpu.profiling.flops_profiler.profiler import FlopsProfiler
-        self.flops_profiler = FlopsProfiler(self.module)
+        # Share this engine's observatory: profiled programs and the
+        # fused-step stash land in ONE record set (and one AOT-analysis
+        # cache), so perf_xray() and the profiler report agree.
+        self.flops_profiler = FlopsProfiler(self.module, xray=self.xray)
         self.flops_profiler.start_profile()
 
     def _stop_flops_profiler(self):
@@ -2086,6 +2109,13 @@ class DeepSpeedEngine(object):
             self.flops_profiler.print_model_profile(
                 top_modules=self._config.flops_profiler_config.top_modules)
             self.flops_profiler.end_profile()
+
+    def perf_xray(self):
+        """The schema-versioned ``perf_xray`` section for the training
+        side: every fused step program this engine compiled, with HLO
+        fingerprint, cost-model flops/bytes, and the peak-HBM split.
+        First call pays the one-time AOT analysis (off the step path)."""
+        return self.xray.to_json()
 
     # ------------------------------------------------------------- checkpoint
 

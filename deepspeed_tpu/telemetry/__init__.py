@@ -26,6 +26,11 @@ One dependency-free subsystem every engine emits into:
   "why was this request slow?" answer assembled from the rings.
 - ``AlertRule`` / ``AlertManager`` / ``default_rules`` (alerts.py):
   declarative SLO burn-rate alerting over the collector's windows.
+- ``ProgramRegistry`` / ``HBMLedger`` / ``cost_model_gate`` (xray.py):
+  the compiled-program cost/memory observatory — per-program HLO
+  fingerprints, cost_analysis flops/bytes, roofline gauges against
+  ``PLATFORM_PEAKS``, the predicted-vs-live HBM ledger, and the
+  hardware-free cost-model regression gate.
 
 See docs/OBSERVABILITY.md for the full contract.
 """
@@ -66,6 +71,12 @@ from deepspeed_tpu.telemetry.registry import (
 )
 from deepspeed_tpu.telemetry.timeseries import TimeseriesCollector
 from deepspeed_tpu.telemetry.tracing import NullRecorder, SpanRecorder
+from deepspeed_tpu.telemetry.xray import (
+    PLATFORM_PEAKS,
+    HBMLedger,
+    ProgramRegistry,
+    cost_model_gate,
+)
 
 __all__ = [
     "TimeseriesCollector",
@@ -95,4 +106,8 @@ __all__ = [
     "AlertRule",
     "AlertManager",
     "default_rules",
+    "ProgramRegistry",
+    "HBMLedger",
+    "cost_model_gate",
+    "PLATFORM_PEAKS",
 ]

@@ -204,11 +204,13 @@ class AlertRule(object):
 
 def default_rules(ttft_budget_s=1.0, itl_budget_s=0.25, objective=0.95,
                   burn_threshold=2.0, queue_saturation=32,
-                  fallback_rate=1.0):
+                  fallback_rate=1.0, hbm_pressure=0.92):
     """The serving stack's standard rule set — TTFT and inter-token
-    burn, queue saturation, breaker-opens and handoff-fallback rate.
-    Every knob has a keyword so bench and tests can tighten them into
-    firing range without inventing rule syntax."""
+    burn, queue saturation, breaker-opens, handoff-fallback rate, and
+    HBM pressure (the xray ledger's 0..1 fill gauge; it reads 0 when
+    capacity is unknown — a CPU round can never fire it). Every knob
+    has a keyword so bench and tests can tighten them into firing
+    range without inventing rule syntax."""
     return [
         AlertRule("ttft_burn", "burn_rate", "ttft_seconds",
                   burn_threshold, objective=objective,
@@ -222,6 +224,8 @@ def default_rules(ttft_budget_s=1.0, itl_budget_s=0.25, objective=0.95,
                   windows=1),
         AlertRule("handoff_fallbacks", "rate", "handoff_fallbacks",
                   fallback_rate, windows=3),
+        AlertRule("hbm_pressure", "saturation", "hbm_pressure",
+                  hbm_pressure, windows=3),
     ]
 
 

@@ -41,11 +41,16 @@ class RecompileDetector(object):
     the gauges — call it at step boundaries (cheap: one int read per
     program). ``mark_warm()`` freezes the expected total; any growth
     past it increments the ``recompiles`` counter and logs a warning
-    naming the offender."""
+    naming the offender. ``describe`` is an optional ``label -> str``
+    hook (the xray ProgramRegistry's ``identity``) that lets the
+    warning name the exact program: HLO fingerprint plus old -> new
+    shape signature — the same identity key the autopsy reports, so
+    the page and the post-mortem agree on WHICH program recompiled."""
 
-    def __init__(self, registry, **labels):
+    def __init__(self, registry, describe=None, **labels):
         self._registry = registry
         self._labels = labels
+        self._describe = describe
         self._programs = {}
         self._last = {}
         self._warm_total = None
@@ -89,12 +94,20 @@ class RecompileDetector(object):
                 if self._warm_total is not None:
                     new_after_warm += grew
                     self.recompiles.inc(grew)
+                    ident = ""
+                    if self._describe is not None:
+                        try:
+                            got = self._describe(label)
+                            if got:
+                                ident = " [{}]".format(got)
+                        except Exception:
+                            ident = ""
                     logger.warning(
                         "telemetry: program %r recompiled (%d new "
                         "compilation%s, total compile_count=%d) after "
                         "warmup — a traced value became static or a "
-                        "shape changed", label, grew,
-                        "" if grew == 1 else "s", self.total())
+                        "shape changed%s", label, grew,
+                        "" if grew == 1 else "s", self.total(), ident)
         return new_after_warm
 
 

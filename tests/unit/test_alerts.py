@@ -152,13 +152,20 @@ def test_default_rules_cover_stack_and_take_knobs():
         ttft_budget_s=0.2, itl_budget_s=0.05, objective=0.9,
         burn_threshold=3.0, queue_saturation=16, fallback_rate=2.0)}
     assert sorted(rules) == ["breaker_open", "handoff_fallbacks",
-                             "itl_burn", "queue_saturated", "ttft_burn"]
+                             "hbm_pressure", "itl_burn", "queue_saturated",
+                             "ttft_burn"]
     assert rules["ttft_burn"].budget_s == 0.2
     assert rules["ttft_burn"].threshold == 3.0
     assert rules["itl_burn"].metric == "inter_token_seconds"
     assert rules["queue_saturated"].threshold == 16
     assert rules["breaker_open"].windows == 1
     assert rules["handoff_fallbacks"].kind == "rate"
+    # HBM saturation (perf x-ray ledger): saturation rule on the
+    # hbm_pressure gauge; the gauge reads 0 when capacity is unknown
+    # (CPU), so the default rule can never fire there.
+    assert rules["hbm_pressure"].kind == "saturation"
+    assert rules["hbm_pressure"].metric == "hbm_pressure"
+    assert rules["hbm_pressure"].threshold == pytest.approx(0.92)
 
 
 # --------------------------------------------------------------- manager

@@ -477,6 +477,22 @@ def test_serving_smoke_measures_in_process(bench):
     assert e["recompiles_after_warmup"] == 0
     assert 0.0 < e["slot_occupancy"] <= 1.0
     assert e["p50_per_token_latency_ms"] <= e["p99_per_token_latency_ms"]
+    # Perf X-ray acceptance (ISSUE): the CPU-only artifact carries a
+    # POPULATED cost/memory section — >= 3 programs with nonzero
+    # cost-model flops and predicted peak HBM, honest platform="cpu"
+    # labels, and NO fabricated utilization (no peaks row on CPU).
+    xray = e["perf_xray"]
+    active = [p for p in xray["programs"] if not p["superseded"]]
+    assert len(active) >= 3
+    assert {"mixed_step", "prefill", "decode_chunk"} <= {
+        p["program"] for p in active}
+    for p in active:
+        assert p["flops"] > 0 and p["peak_hbm_bytes"] > 0
+        assert p["platform"] == "cpu"
+    assert xray["platform"] == "cpu" and xray["peaks"] is None
+    assert xray["totals"]["bytes_per_token"] > 0
+    assert xray["recompiles"] == []
+    assert xray["hbm"]["predicted_bytes"] > 0
     json.dumps(r)  # driver-facing line must be JSON-serializable
 
 
@@ -572,3 +588,66 @@ def test_serving_smoke_carries_telemetry_snapshot(bench):
     assert counts["request/queued"] == counts["request"]
     assert counts.get("step/mixed", 0) > 0
     json.dumps(r)
+
+
+def test_probe_telemetry_counters_and_state_gauge(bench, monkeypatch):
+    """The probe diagnostics are PROMOTED to telemetry: every attempt
+    increments bench_probe_attempts_total (labeled by outcome) and the
+    bench_probe_state gauge is one-hot over the probe verdict — so a
+    wedged-probe round is visible on the same Prometheus plane as the
+    serving metrics, not only in a JSON sidecar."""
+    from deepspeed_tpu.telemetry import prometheus_text
+
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    clock = FakeClock()
+    monkeypatch.setattr(bench.time, "time", clock.time)
+    n = [0]
+
+    def probe(timeout):
+        clock.t += 10
+        n[0] += 1
+        return (n[0] >= 3), "relay wedged"
+
+    assert bench._device_probe(budget=480, probe=probe, sleep=clock.sleep)
+    text = prometheus_text(bench._bench_telemetry())
+    assert 'ds_tpu_bench_probe_attempts_total{outcome="error"} 2' in text
+    assert 'ds_tpu_bench_probe_attempts_total{outcome="ok"} 1' in text
+    assert 'ds_tpu_bench_probe_state{state="probed"} 1' in text
+    assert 'ds_tpu_bench_probe_state{state="gave_up"} 0' in text
+    # A later cached answer flips the one-hot to "cached".
+    assert bench._device_probe(probe=probe, sleep=clock.sleep)
+    text = prometheus_text(bench._bench_telemetry())
+    assert 'ds_tpu_bench_probe_state{state="cached"} 1' in text
+    assert 'ds_tpu_bench_probe_state{state="probed"} 0' in text
+
+
+def test_probe_giveup_sets_gave_up_state(bench, monkeypatch):
+    from deepspeed_tpu.telemetry import prometheus_text
+
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    clock = FakeClock()
+    monkeypatch.setattr(bench.time, "time", clock.time)
+
+    def probe(timeout):
+        clock.t += 60
+        return False, "wedged"
+
+    assert not bench._device_probe(budget=120, probe=probe,
+                                   sleep=clock.sleep)
+    text = prometheus_text(bench._bench_telemetry())
+    assert 'ds_tpu_bench_probe_state{state="gave_up"} 1' in text
+    # The verdict is telemetry-only: the module global stays None so a
+    # cleared wedge is re-probed, never served from a failure cache.
+    assert bench._PROBE_STATE is None
+
+
+def test_emit_fallback_counts_and_carries_bench_prometheus(bench,
+                                                           monkeypatch,
+                                                           capsys):
+    monkeypatch.setenv("DS_BENCH_FALLBACK", "accelerator-init-failed")
+    bench._emit({"metric": "m", "value": 1.0, "unit": "u",
+                 "vs_baseline": 1.0, "extra": {"platform": "cpu"}})
+    out = json.loads(capsys.readouterr().out.strip())
+    text = out["extra"]["bench_prometheus"]
+    assert ('ds_tpu_bench_fallbacks_total'
+            '{reason="accelerator-init-failed"} 1') in text
