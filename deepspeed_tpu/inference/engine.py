@@ -2332,7 +2332,7 @@ class InferenceEngine(object):
             "recompiles": int(self.recompile_detector.recompiles.value),
             # Stashed-label count only — a snapshot must stay cheap,
             # so it never materializes the observatory.
-            "xray_programs": (len(self._xray._programs)
+            "xray_programs": (self._xray.program_count()
                               if self._xray is not None else 0),
         }
 

@@ -71,7 +71,8 @@ class FlopsProfiler(object):
         self.model = model
         self.started = False
         self._xray = xray
-        self._labels = {}
+        self._labels = {}        # id(fn) -> (fn, label); fn ref pins id
+        self._used_labels = set()
         self.reset_profile()
 
     # ----------------------------------------------------------- lifecycle
@@ -98,21 +99,39 @@ class FlopsProfiler(object):
         self.reset_profile()
 
     # ------------------------------------------------------------ observers
+    def _label_for(self, jitted_fn):
+        """A registry label UNIQUE per program object: two distinct
+        jitted fns sharing a ``__name__`` (two '<lambda>'s, two 'step's)
+        must not collapse to one record — the registry dedupes on
+        (label, signature), so a collision would silently double-count
+        the first program's cost. The fn itself is held in the map:
+        id() keys are only stable while the object is alive."""
+        key = id(jitted_fn)
+        entry = self._labels.get(key)
+        if entry is not None:
+            return entry[1]
+        base = getattr(jitted_fn, "__name__", None) or "program"
+        label, n = base, len(self._labels)
+        while label in self._used_labels:
+            label = "{}#{}".format(base, n)
+            n += 1
+        self._used_labels.add(label)
+        self._labels[key] = (jitted_fn, label)
+        return label
+
     def observe(self, jitted_fn, *args, **kwargs):
         """Record the XLA-compiled cost of one program invocation. The engine
         calls this with its fused fwd+bwd program, so totals reflect the real
         executed flops (fwd+bwd+update), not an estimate. Thin xray client:
         the ProgramRegistry owns the AOT compile, the fingerprint, and the
-        per-(program, shapes) cache."""
+        per-(program, shapes) cache. ``tokens=`` is reserved for the
+        registry's accounting, never forwarded to the program."""
         try:
             if self._xray is None:
                 from deepspeed_tpu.telemetry import ProgramRegistry
 
                 self._xray = ProgramRegistry()
-            label = self._labels.setdefault(
-                id(jitted_fn),
-                getattr(jitted_fn, "__name__", None)
-                or "program{}".format(len(self._labels)))
+            label = self._label_for(jitted_fn)
             record = self._xray.observe(label, jitted_fn, *args, **kwargs)
             self._total_flops += record["flops"]
             self._total_bytes += record["bytes_accessed"]

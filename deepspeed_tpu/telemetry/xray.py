@@ -21,9 +21,12 @@ Three pieces:
   Steady-state per-step cost is one signature tuple + a dict compare.
   Each record holds the HLO fingerprint (sha256 of the lowered text),
   input shapes/static args, flops, bytes accessed, the peak-HBM split,
-  and the donation map. A second signature under the same label is a
-  program-identity change: ``RecompileDetector`` warnings and the
+  and the donation map. A genuinely NEW signature under the same label
+  is a program-identity change: ``RecompileDetector`` warnings and the
   autopsy both name it through ``identity()`` / ``recompile_dicts()``.
+  A signature seen before (warm prompt buckets alternating on the
+  legacy prefill path) only flips the active pointer — it is in the
+  jit cache already, so nothing accumulates and nothing logs.
 
 - Roofline gauges: per-program ``xray_mfu`` / ``xray_mbu`` /
   ``xray_roofline_ratio`` from cost-model flops ÷ sampled step wall
@@ -56,6 +59,11 @@ from deepspeed_tpu.utils.logging import logger
 # Version stamp of the ``perf_xray`` artifact section. Bump on any
 # field rename/removal; the gate refuses to compare across versions.
 SCHEMA_VERSION = 1
+
+# Bound on retained recompile events: a genuine recompile loop must not
+# grow the autopsy (or the registry) without bound. Overflow is counted
+# in ``recompile_events_dropped``, never silent.
+RECOMPILE_EVENT_CAP = 64
 
 # Per-platform peak compute / memory bandwidth for the roofline gauges.
 # Entries are honest or absent: a platform mapped to None (or missing)
@@ -143,10 +151,13 @@ def _shapes_of(sig):
 
 class _Stash(object):
     """One (label, signature) capture: abstract args now, compiled
-    analysis later (``record`` is filled by materialize())."""
+    analysis later (``record`` is filled by materialize()). ``calls``/
+    ``tokens`` accumulate the note() accounting for the steps this
+    signature was active — cost attribution stays per-signature even
+    when a label cycles through several (legacy prefill buckets)."""
 
     __slots__ = ("label", "sig", "jitted", "args", "kwargs", "donate",
-                 "record")
+                 "record", "calls", "tokens")
 
     def __init__(self, label, sig, jitted, args, kwargs, donate):
         self.label = label
@@ -156,6 +167,13 @@ class _Stash(object):
         self.kwargs = kwargs
         self.donate = tuple(donate)
         self.record = None
+        self.calls = 0
+        self.tokens = 0
+
+
+def _public_event(ev):
+    """A recompile event minus its internal stash references."""
+    return {k: v for k, v in ev.items() if not k.startswith("_")}
 
 
 class ProgramRegistry(object):
@@ -172,11 +190,14 @@ class ProgramRegistry(object):
         self._peaks_override = peaks
         self._sample_every = int(sample_every)
         self._lock = threading.Lock()
-        self._programs = {}      # label -> [stash, ...] (last = active)
+        self._programs = {}      # label -> [stash, ...] (insertion order)
+        self._sig_index = {}     # label -> {sig: stash}
         self._active_sig = {}    # label -> signature tuple
+        self._active = {}        # label -> active stash
+        self._prev_active = {}   # label -> previously active stash
         self._active_parts = {}  # label -> per-arg parts (fast path)
         self._sig_memo = {}      # label -> [(arg, parts) | None, ...]
-        self._counts = {}        # label -> [calls, tokens]
+        self._pending = {}       # label -> [calls, tokens] pre-stash
         self._step_s = {}        # label -> EWMA sampled step seconds
         self._decomp = {}        # label -> [n, host_sum, wait_sum]
         self._gauged = set()     # labels with published gauges
@@ -184,9 +205,12 @@ class ProgramRegistry(object):
         self._tick = 0
         # Program-identity changes flagged by a call site (the engine
         # passes track_change=detector.warm, so pre-warmup bucket
-        # accumulation never lands here). Fingerprints fill lazily at
+        # accumulation never lands here; an already-seen signature
+        # never lands here either — it is in the jit cache, so a flip
+        # back to it is not a recompile). Fingerprints fill lazily at
         # materialize() — the shapes are exact from the stash itself.
         self.recompile_events = []
+        self.recompile_events_dropped = 0
 
     # ------------------------------------------------------- hot path
 
@@ -215,13 +239,24 @@ class ProgramRegistry(object):
                 parts[i] = p
         return tuple(parts)
 
-    def stash(self, label, jitted, *args, **kwargs):
+    def stash(self, label, jitted, *args, donate=(), track_change=False,
+              **kwargs):
         """Capture one call's program identity. Returns True when the
-        label's signature CHANGED (first stash included). ``donate``
-        names the donated arguments for the record; ``track_change``
-        additionally logs a signature change as a recompile event."""
-        donate = kwargs.pop("donate", ())
-        track_change = kwargs.pop("track_change", False)
+        label's ACTIVE signature changed (first stash included).
+
+        A signature already seen under this label (the legacy prefill
+        path alternating between warm prompt buckets) only switches the
+        active pointer: the program is in the jit cache, so nothing is
+        appended and no recompile event is logged — only a genuinely
+        NEW signature captures a stash, and only a new one with
+        ``track_change`` set records a recompile event (bounded by
+        RECOMPILE_EVENT_CAP; overflow counts as
+        ``recompile_events_dropped``).
+
+        ``donate`` (names of donated arguments) and ``track_change``
+        are reserved keyword-only options, never forwarded to the
+        program; a profiled program whose own kwargs use these names
+        must pre-bind them (``functools.partial``)."""
         parts = None
         if not kwargs:
             # Steady-state fast path: signature parts memoized by arg
@@ -240,40 +275,65 @@ class ProgramRegistry(object):
             if parts is not None:
                 self._active_parts[label] = parts
             return False
-        a_args, a_kwargs = _abstractify((args, kwargs))
         with self._lock:
             if self._active_sig.get(label) == sig:
                 if parts is not None:
                     self._active_parts[label] = parts
                 return False
-            chain = self._programs.setdefault(label, [])
-            old = chain[-1] if chain else None
-            chain.append(_Stash(label, sig, jitted, a_args, a_kwargs,
-                                donate))
+            by_sig = self._sig_index.setdefault(label, {})
+            old = self._active.get(label)
+            stash = by_sig.get(sig)
+            is_new = stash is None
+            if is_new:
+                a_args, a_kwargs = _abstractify((args, kwargs))
+                stash = _Stash(label, sig, jitted, a_args, a_kwargs,
+                               donate)
+                pend = self._pending.pop(label, None)
+                if pend is not None:
+                    stash.calls, stash.tokens = pend
+                by_sig[sig] = stash
+                self._programs.setdefault(label, []).append(stash)
             self._active_sig[label] = sig
+            self._active[label] = stash
+            if old is not None and old is not stash:
+                self._prev_active[label] = old
             if parts is not None:
                 self._active_parts[label] = parts
             else:
                 self._active_parts.pop(label, None)
-            if old is not None and track_change:
-                self.recompile_events.append({
-                    "program": label,
-                    "old_fingerprint": (old.record or {}).get(
-                        "fingerprint"),
-                    "new_fingerprint": None,
-                    "old_shapes": _shapes_of(old.sig),
-                    "new_shapes": _shapes_of(sig),
-                })
+            if is_new and old is not None and track_change:
+                if len(self.recompile_events) >= RECOMPILE_EVENT_CAP:
+                    self.recompile_events_dropped += 1
+                else:
+                    self.recompile_events.append({
+                        "program": label,
+                        "old_fingerprint": (old.record or {}).get(
+                            "fingerprint"),
+                        "new_fingerprint": None,
+                        "old_shapes": _shapes_of(old.sig),
+                        "new_shapes": _shapes_of(sig),
+                        # Stash refs (stripped on export) let
+                        # materialize() resolve fingerprints exactly.
+                        "_old": old,
+                        "_new": stash,
+                    })
         return True
 
     def note(self, label, tokens=0):
-        """Per-step accounting: one call, ``tokens`` emitted. Two int
-        adds — the flops/token and bytes/token denominators."""
-        c = self._counts.get(label)
-        if c is None:
-            c = self._counts.setdefault(label, [0, 0])
-        c[0] += 1
-        c[1] += tokens
+        """Per-step accounting against the label's ACTIVE signature:
+        one call, ``tokens`` emitted — the per-record flops/token and
+        bytes/token denominators. (Notes landing before any stash are
+        held and folded into the label's first stash.)"""
+        stash = self._active.get(label)
+        if stash is not None:
+            stash.calls += 1
+            stash.tokens += tokens
+            return
+        p = self._pending.get(label)
+        if p is None:
+            p = self._pending.setdefault(label, [0, 0])
+        p[0] += 1
+        p[1] += tokens
 
     def due(self):
         """Deterministic 1-in-N sampler for the step decomposition.
@@ -394,24 +454,20 @@ class ProgramRegistry(object):
                 donated=list(stash.donate),
             )
         for ev in self.recompile_events:
-            if ev["new_fingerprint"] is None:
-                chain = self._programs.get(ev["program"], [])
-                for stash in reversed(chain):
-                    if stash.record is not None:
-                        ev["new_fingerprint"] = stash.record[
-                            "fingerprint"]
-                        break
-                for stash in chain:
-                    if (stash.record is not None
-                            and _shapes_of(stash.sig)
-                            == ev["old_shapes"]):
-                        ev["old_fingerprint"] = stash.record[
-                            "fingerprint"]
-                        break
+            for side in ("old", "new"):
+                if ev[side + "_fingerprint"] is None:
+                    rec = ev["_" + side].record
+                    if rec is not None:
+                        ev[side + "_fingerprint"] = rec["fingerprint"]
         for label in list(self._programs):
             self._publish(label)
 
-    def _latest_record(self, label):
+    def _active_record(self, label):
+        """The ACTIVE signature's record, falling back to any
+        materialized record under the label."""
+        stash = self._active.get(label)
+        if stash is not None and stash.record is not None:
+            return stash.record
         for stash in reversed(self._programs.get(label, [])):
             if stash.record is not None:
                 return stash.record
@@ -424,14 +480,14 @@ class ProgramRegistry(object):
         peaks row AND a sampled step time exists."""
         if self._registry is None or label in self._gauged:
             return
-        if self._latest_record(label) is None:
+        if self._active_record(label) is None:
             return
         self._gauged.add(label)
         plat = self.platform()
         reg = self._registry
 
         def rec_field(field, label=label):
-            rec = self._latest_record(label)
+            rec = self._active_record(label)
             return float(rec[field]) if rec else 0.0
 
         reg.gauge("xray_flops", program=label, platform=plat).set_fn(
@@ -466,33 +522,32 @@ class ProgramRegistry(object):
         reg.gauge("xray_roofline_ratio", program=label,
                   platform=plat).set_fn(ratio)
 
-    def observe(self, label, jitted, *args, **kwargs):
+    def observe(self, label, jitted, *args, tokens=0, **kwargs):
         """Stash + materialize + count, returning the record — the
-        flops profiler's synchronous mode. Step paths use stash()."""
-        tokens = kwargs.pop("tokens", 0)
+        flops profiler's synchronous mode. Step paths use stash().
+        ``tokens`` is a reserved keyword-only option (see stash())."""
         self.stash(label, jitted, *args, **kwargs)
         self.materialize()
         self.note(label, tokens)
-        return self._latest_record(label)
+        return self._active_record(label)
 
     def identity(self, label):
         """One-line program identity for RecompileDetector warnings:
         fingerprint + shapes, old -> new when the signature changed.
         Never compiles — an unmaterialized fingerprint says 'pending'
         (the autopsy's recompile_dicts() resolves it)."""
-        chain = self._programs.get(label)
-        if not chain:
+        cur = self._active.get(label)
+        if cur is None:
             return None
 
         def fp(stash):
             return (stash.record or {}).get("fingerprint") or "pending"
 
-        cur = chain[-1]
         cur_s = "fingerprint {} shapes ({})".format(
             fp(cur), ", ".join(_shapes_of(cur.sig)))
-        if len(chain) < 2:
+        old = self._prev_active.get(label)
+        if old is None:
             return cur_s
-        old = chain[-2]
         return "fingerprint {} shapes ({}) -> {}".format(
             fp(old), ", ".join(_shapes_of(old.sig)), cur_s)
 
@@ -500,7 +555,14 @@ class ProgramRegistry(object):
         """Recompile events with fingerprints resolved (materializes)."""
         if self.recompile_events:
             self.materialize()
-        return [dict(ev) for ev in self.recompile_events]
+        return [_public_event(ev) for ev in self.recompile_events]
+
+    def program_count(self):
+        """Number of stashed program labels — the cheap fact a
+        telemetry snapshot reports (takes the registry lock; never
+        materializes)."""
+        with self._lock:
+            return len(self._programs)
 
     def max_temp_bytes(self):
         """Largest temp allocation across MATERIALIZED programs (0
@@ -521,25 +583,30 @@ class ProgramRegistry(object):
         tokens_total = calls_total = 0
         for label in sorted(self._programs):
             chain = self._programs[label]
-            calls, tokens = self._counts.get(label, (0, 0))
+            active = self._active.get(label)
             for stash in chain:
                 entry = dict(stash.record or {
                     "program": label,
                     "input_shapes": _shapes_of(stash.sig),
                 })
-                entry["superseded"] = stash is not chain[-1]
-                if stash is chain[-1]:
-                    entry["calls"] = calls
-                    entry["tokens"] = tokens
+                entry["superseded"] = stash is not active
+                entry["calls"] = stash.calls
+                entry["tokens"] = stash.tokens
+                if stash is active:
                     entry["sampled_step_seconds"] = self._step_s.get(
                         label)
                 programs.append(entry)
-            rec = self._latest_record(label)
-            if rec is not None:
-                flops_total += rec["flops"] * max(calls, 1)
-                bytes_total += rec["bytes_accessed"] * max(calls, 1)
-            tokens_total += tokens
-            calls_total += calls
+                # Totals attribute each record's cost to ITS OWN call
+                # count (a never-dispatched AOT capture still counts
+                # once) — a label cycling through several signatures
+                # never bills one signature's cost to another's calls.
+                if stash.record is not None:
+                    flops_total += (stash.record["flops"]
+                                    * max(stash.calls, 1))
+                    bytes_total += (stash.record["bytes_accessed"]
+                                    * max(stash.calls, 1))
+                tokens_total += stash.tokens
+                calls_total += stash.calls
         peaks = self.peaks()
         out = {
             "schema_version": SCHEMA_VERSION,
@@ -556,7 +623,9 @@ class ProgramRegistry(object):
                 "bytes_per_token": (bytes_total / tokens_total
                                     if tokens_total else None),
             },
-            "recompiles": [dict(ev) for ev in self.recompile_events],
+            "recompiles": [_public_event(ev)
+                           for ev in self.recompile_events],
+            "recompiles_dropped": self.recompile_events_dropped,
             "decomposition": {
                 label: {"samples": d[0], "host_dispatch_s": d[1],
                         "device_wait_s": d[2]}

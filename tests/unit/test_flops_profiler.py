@@ -47,6 +47,28 @@ def test_profiler_observe_accumulates():
     assert isinstance(s, str) and ("M" in s or "K" in s or "G" in s)
 
 
+def test_distinct_programs_with_same_name_keep_distinct_records():
+    """Two different jitted programs both named '<lambda>' (and fed the
+    same-shaped input) must not collapse to one registry record — the
+    second program's flops are its own, not a dedupe of the first."""
+    prof = FlopsProfiler()
+    prof.start_profile()
+    f1 = jax.jit(lambda a: a @ a)
+    f2 = jax.jit(lambda a: jnp.tanh(a @ a) @ a)
+    x = jnp.ones((32, 32))
+    prof.observe(f1, x)
+    first = prof.get_total_flops()
+    prof.observe(f2, x)
+    assert prof.get_total_steps() == 2
+    # f2 does two matmuls: its contribution strictly exceeds f1's.
+    assert prof.get_total_flops() > 2 * first * 0.9
+    assert prof.get_total_flops() != 2 * first
+    # Two labels, two program records in the shared registry.
+    assert prof._xray.program_count() == 2
+    recs = prof._xray.to_json()["programs"]
+    assert len({r["fingerprint"] for r in recs}) == 2
+
+
 def test_engine_profiler_hook():
     """flops_profiler config block triggers profiling at start/end steps."""
     from deepspeed_tpu.models.simple import SimpleModel

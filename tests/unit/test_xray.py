@@ -105,6 +105,80 @@ def test_stash_fast_path_and_recompile_events_resolve():
     assert "->" in ident and "float32[4,16]" in ident
 
 
+def test_stash_seen_signature_flips_never_accumulate_or_log():
+    """Legacy-path bucket variety: alternating between two warm prompt
+    buckets must not grow the stash chain (each holds an abstracted
+    params tree) nor log recompile events — both signatures are in the
+    jit cache, so a flip back is not a recompile."""
+    fn, x, y = _toy()
+    x2 = jnp.ones((4, 16), jnp.float32)
+    xr = ProgramRegistry()
+    assert xr.stash("p", fn, x, y, track_change=True) is True
+    assert xr.stash("p", fn, x2, y, track_change=True) is True
+    assert len(xr.recompile_events) == 1    # genuinely new signature
+    for _ in range(50):
+        assert xr.stash("p", fn, x, y, track_change=True) is True
+        assert xr.stash("p", fn, x2, y, track_change=True) is True
+    assert len(xr._programs["p"]) == 2      # one stash per signature
+    assert len(xr.recompile_events) == 1    # no event per flip
+    assert xr.recompile_events_dropped == 0
+    # A genuinely NEW third signature still captures and logs.
+    x3 = jnp.ones((2, 16), jnp.float32)
+    assert xr.stash("p", fn, x3, y, track_change=True) is True
+    assert len(xr._programs["p"]) == 3
+    assert len(xr.recompile_events) == 2
+
+
+def test_recompile_events_are_capped_not_unbounded():
+    from deepspeed_tpu.telemetry.xray import RECOMPILE_EVENT_CAP
+
+    fn, _, y = _toy()
+    xr = ProgramRegistry()
+    n = RECOMPILE_EVENT_CAP + 6
+    for i in range(1, n + 2):
+        xr.stash("p", fn, jnp.ones((i, 16), jnp.float32), y,
+                 track_change=True)
+    assert len(xr.recompile_events) == RECOMPILE_EVENT_CAP
+    assert xr.recompile_events_dropped == n - RECOMPILE_EVENT_CAP
+
+
+def test_note_attributes_calls_and_cost_per_signature():
+    """Cost totals bill each signature's record for ITS OWN calls —
+    a label cycling buckets must not attribute the latest signature's
+    cost to every historical call."""
+    fn, x, y = _toy()
+    x2 = jnp.ones((4, 16), jnp.float32)
+    xr = ProgramRegistry()
+    xr.stash("p", fn, x, y)
+    xr.note("p", tokens=2)
+    xr.note("p", tokens=2)
+    xr.stash("p", fn, x2, y)
+    xr.note("p", tokens=8)
+    section = xr.to_json()
+    big = next(e for e in section["programs"]
+               if "float32[8,16]" in e["input_shapes"][0])
+    small = next(e for e in section["programs"]
+                 if "float32[4,16]" in e["input_shapes"][0])
+    assert big["superseded"] and not small["superseded"]
+    assert (big["calls"], big["tokens"]) == (2, 4)
+    assert (small["calls"], small["tokens"]) == (1, 8)
+    t = section["totals"]
+    assert t["calls"] == 3 and t["tokens"] == 12
+    assert t["flops_total"] == pytest.approx(
+        big["flops"] * 2 + small["flops"] * 1)
+    assert t["bytes_total"] == pytest.approx(
+        big["bytes_accessed"] * 2 + small["bytes_accessed"] * 1)
+    # Flipping BACK re-activates the first signature; its accounting
+    # resumes where it left off.
+    xr.stash("p", fn, x, y)
+    xr.note("p", tokens=1)
+    section2 = xr.to_json()
+    big2 = next(e for e in section2["programs"]
+                if "float32[8,16]" in e["input_shapes"][0])
+    assert not big2["superseded"]
+    assert (big2["calls"], big2["tokens"]) == (3, 5)
+
+
 # ----------------------------------------------------------- prometheus
 
 
